@@ -1,0 +1,17 @@
+"""neuronx_distributed_tpu — a TPU-native distributed training & inference framework.
+
+Capability surface mirrors AWS NeuronxDistributed (see SURVEY.md); the
+implementation is idiomatic JAX/XLA: a ``jax.sharding.Mesh`` instead of
+process groups, GSPMD/pjit + explicit ``shard_map`` collectives instead of
+hand-issued ``xm.*`` ops, ``lax.ppermute`` pipeline p2p, Pallas kernels for
+flash attention, and optimizer-state sharding for ZeRO-1.
+"""
+
+from neuronx_distributed_tpu.parallel import mesh as parallel_state  # noqa: F401
+from neuronx_distributed_tpu.parallel.mesh import (  # noqa: F401
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+    destroy_model_parallel,
+)
+
+__version__ = "0.1.0"

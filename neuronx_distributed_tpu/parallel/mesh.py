@@ -1,0 +1,241 @@
+"""Parallel state: who talks to whom, expressed as a ``jax.sharding.Mesh``.
+
+TPU-native re-design of the reference's process-group bookkeeping
+(``src/neuronx_distributed/parallel_layers/parallel_state.py`` — the
+``initialize_model_parallel`` / ``get_*_parallel_{group,rank,size}`` surface,
+reference lines 60, 454-622).
+
+The reference builds explicit ``torch.distributed`` process groups from a
+row-major rank tensor reshaped to ``[PP, DP, TP]`` (non-expert view) and
+``[PP, DP_exp, EP, TP]`` (expert view), TP contiguous/innermost
+(``parallel_state.py:74-184``), and attaches SPMD replica-group meshes to each
+group so collectives lower with explicit ``replica_groups``
+(``parallel_state.py:410-417``).
+
+On TPU under JAX there is ONE object that expresses all of that at once: a
+``jax.sharding.Mesh`` whose axis order fixes device adjacency. We build the
+mesh with axes ``(pp, edp, ep, tp)`` — TP innermost so TP collectives ride
+the fastest ICI links, PP outermost so pipeline stages may span DCN —
+and every "process group" of the reference becomes a mesh *axis name* (or a
+tuple of axis names):
+
+==============================  =================================
+reference group                 mesh axes
+==============================  =================================
+tensor model parallel (TP)      ``"tp"``
+pipeline model parallel (PP)    ``"pp"``
+expert model parallel (EP)      ``"ep"``
+data parallel (DP)              ``("edp", "ep")``  (combined)
+expert data parallel (EDP)      ``"edp"``
+==============================  =================================
+
+Collectives take axis names instead of group handles: XLA emits the
+replica-group lists itself from the mesh, so the reference's
+``_build_and_assign_groups`` / replica-group-compression machinery
+(``parallel_state.py:283,388-417``) has no TPU equivalent to write — the
+compiler owns it. Ranks are positions along a mesh axis: inside a
+``shard_map`` region, ``jax.lax.axis_index(axis)``; outside, per-host values
+derived from the process index for checkpoint naming.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+logger = logging.getLogger("nxd")
+
+# Canonical mesh axis names. TP is innermost (fastest-varying => ICI-adjacent
+# devices), mirroring the reference's TP-contiguous rank layout
+# (parallel_state.py:74-184).
+PP_AXIS = "pp"
+EDP_AXIS = "edp"  # expert-data-parallel: DP leftover after EP split
+EP_AXIS = "ep"
+TP_AXIS = "tp"
+MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, TP_AXIS)
+# The reference's plain data-parallel group == (edp x ep) combined
+# (parallel_state.py:285-298: DP is the product of everything that is not
+# TP/PP; EP subdivides it in the expert view).
+DP_AXES = (EDP_AXIS, EP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelState:
+    """Immutable snapshot of the initialized world."""
+
+    mesh: Mesh
+    tensor_model_parallel_size: int
+    pipeline_model_parallel_size: int
+    expert_model_parallel_size: int
+    data_parallel_size: int
+    expert_data_parallel_size: int
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+
+_STATE: Optional[ParallelState] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    expert_model_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ParallelState:
+    """Build the global device mesh (reference ``initialize_model_parallel``,
+    ``parallel_state.py:60``).
+
+    world = pp * dp * tp, with dp = edp * ep. Raises if the device count does
+    not factor (mirrors the reference's divisibility asserts).
+    """
+    global _STATE
+    if _STATE is not None:
+        raise RuntimeError("model parallel already initialized; call destroy_model_parallel() first")
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    world = len(devs)
+    tp, pp, ep = tensor_model_parallel_size, pipeline_model_parallel_size, expert_model_parallel_size
+    if world % (tp * pp) != 0:
+        raise ValueError(f"world size {world} is not divisible by tp({tp}) * pp({pp})")
+    dp = world // (tp * pp)
+    if dp % ep != 0:
+        raise ValueError(f"data parallel size {dp} is not divisible by ep({ep})")
+    edp = dp // ep
+
+    # Row-major [PP, EDP, EP, TP]: TP innermost/contiguous — same adjacency
+    # contract as the reference's rank tensor (parallel_state.py:245-261).
+    # On real TPU slices jax.devices() is ordered so that neighbors in the
+    # flat list are ICI neighbors; keeping TP fastest-varying places each TP
+    # group on adjacent chips.
+    mesh_devices = np.asarray(devs, dtype=object).reshape(pp, edp, ep, tp)
+    mesh = Mesh(mesh_devices, MESH_AXES)
+
+    _STATE = ParallelState(
+        mesh=mesh,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        expert_model_parallel_size=ep,
+        data_parallel_size=dp,
+        expert_data_parallel_size=edp,
+    )
+    logger.info(
+        "initialized model parallel: world=%d tp=%d pp=%d dp=%d (ep=%d edp=%d)",
+        world, tp, pp, dp, ep, edp,
+    )
+    return _STATE
+
+
+def model_parallel_is_initialized() -> bool:
+    """Reference ``model_parallel_is_initialized`` (parallel_state.py:430)."""
+    return _STATE is not None
+
+
+def destroy_model_parallel() -> None:
+    """Reference ``destroy_model_parallel`` (parallel_state.py:625)."""
+    global _STATE
+    _STATE = None
+
+
+def _require_state() -> ParallelState:
+    if _STATE is None:
+        raise RuntimeError("model parallel is not initialized; call initialize_model_parallel() first")
+    return _STATE
+
+
+def get_state() -> ParallelState:
+    return _require_state()
+
+
+def get_mesh() -> Mesh:
+    return _require_state().mesh
+
+
+# --- sizes (reference get_*_parallel_size, parallel_state.py:454-622) -------
+
+def get_tensor_model_parallel_size() -> int:
+    return _require_state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_size() -> int:
+    return _require_state().pipeline_model_parallel_size
+
+
+def get_expert_model_parallel_size() -> int:
+    return _require_state().expert_model_parallel_size
+
+
+def get_data_parallel_size() -> int:
+    return _require_state().data_parallel_size
+
+
+def get_expert_data_parallel_size() -> int:
+    return _require_state().expert_data_parallel_size
+
+
+def get_world_size() -> int:
+    return _require_state().world_size
+
+
+# --- in-graph ranks ---------------------------------------------------------
+# Inside a shard_map region over the global mesh, the per-shard rank along an
+# axis is jax.lax.axis_index — the TPU-native equivalent of the reference's
+# get_*_parallel_rank() (parallel_state.py:454-622). These helpers exist so
+# layer code reads like the reference.
+
+def tensor_model_parallel_rank():
+    return jax.lax.axis_index(TP_AXIS)
+
+
+def pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PP_AXIS)
+
+
+def expert_model_parallel_rank():
+    return jax.lax.axis_index(EP_AXIS)
+
+
+def data_parallel_rank():
+    # Combined (edp, ep) rank, row-major — matches the reference's DP group
+    # enumeration (parallel_state.py:285-298).
+    return jax.lax.axis_index(EDP_AXIS) * jax.lax.axis_size(EP_AXIS) + jax.lax.axis_index(EP_AXIS)
+
+
+# --- host-side coordinates (for checkpoint shard naming / logging) ----------
+
+def local_mesh_coords() -> dict:
+    """Mesh coordinates (pp, edp, ep, tp) of this process's first addressable
+    device. Used for rank-tagged logs and checkpoint shard names, standing in
+    for the reference's per-process rank globals."""
+    st = _require_state()
+    first = None
+    addressable = set(d.id for d in jax.local_devices())
+    for idx in np.ndindex(st.mesh.devices.shape):
+        if st.mesh.devices[idx].id in addressable:
+            first = idx
+            break
+    if first is None:  # process owns no mesh device (shouldn't happen)
+        first = (0, 0, 0, 0)
+    pp, edp, ep, tp = first
+    return {"pp": pp, "edp": edp, "ep": ep, "tp": tp, "dp": edp * st.expert_model_parallel_size + ep}
+
+
+def rmsg(msg: str) -> str:
+    """Rank-tagged message (reference ``rmsg``, parallel_state.py:740)."""
+    if _STATE is None:
+        return f"[proc_{jax.process_index()}] {msg}"
+    c = local_mesh_coords()
+    return f"[proc_{jax.process_index()}_pp{c['pp']}_tp{c['tp']}_dp{c['dp']}] {msg}"
+
+
+# --- PartitionSpec helpers --------------------------------------------------
+
+def data_pspec(*trailing) -> PartitionSpec:
+    """Spec for a batch-leading array sharded over the combined DP axes."""
+    return PartitionSpec(DP_AXES, *trailing)

@@ -1,0 +1,35 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests distributed behavior with single-host multi-rank
+``xmp.spawn``/``torchrun`` (reference ``trace/trace.py:335-351``) plus heavy
+mocking of parallel state. On JAX we can do strictly better: XLA's host
+platform exposes N virtual devices in ONE process, so every collective,
+sharding, and pipeline test below runs the real code path with real
+(simulated) devices and no mocks.
+
+This file must set the env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# This image's sitecustomize registers a TPU PJRT plugin and imports jax at
+# interpreter start, so the env var alone is too late — switch via config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    """Each test gets a clean parallel-state world (reference tests re-init per case)."""
+    yield
+    from neuronx_distributed_tpu.parallel import mesh as _mesh
+
+    _mesh.destroy_model_parallel()

@@ -1,0 +1,61 @@
+"""Mesh/parallel-state tests (reference test strategy: unit tests of
+parallel_state group construction, SURVEY.md §4.1)."""
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+def test_initialize_sizes():
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+    assert st.world_size == 8
+    assert ps.get_tensor_model_parallel_size() == 2
+    assert ps.get_pipeline_model_parallel_size() == 2
+    assert ps.get_data_parallel_size() == 2
+    assert ps.get_expert_model_parallel_size() == 1
+    assert st.mesh.devices.shape == (2, 2, 1, 2)
+    assert st.mesh.axis_names == ("pp", "edp", "ep", "tp")
+
+
+def test_tp_innermost_contiguous():
+    """TP groups must be contiguous device ids (reference parallel_state.py:74-184)."""
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    devs = st.mesh.devices.reshape(-1, 4)
+    for row in devs:
+        ids = [d.id for d in row]
+        assert ids == sorted(ids)
+        assert ids[-1] - ids[0] == 3
+
+
+def test_expert_view():
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=2, expert_model_parallel_size=2)
+    assert ps.get_data_parallel_size() == 4
+    assert ps.get_expert_data_parallel_size() == 2
+    assert st.mesh.devices.shape == (1, 2, 2, 2)
+
+
+def test_divisibility_errors():
+    with pytest.raises(ValueError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=3)
+    ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=2)
+
+
+def test_axis_index_in_shard_map():
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+
+    def f():
+        return ps.tensor_model_parallel_rank()[None]
+
+    out = jax.shard_map(
+        f, mesh=st.mesh, in_specs=(), out_specs=jax.sharding.PartitionSpec(("pp", "edp", "ep", "tp"))
+    )()
+    np.testing.assert_array_equal(np.asarray(out), [0, 1, 2, 3, 0, 1, 2, 3])
+
+
+def test_rmsg():
+    ps.initialize_model_parallel()
+    assert ps.rmsg("hello").endswith("hello")

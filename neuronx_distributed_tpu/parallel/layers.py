@@ -1,0 +1,267 @@
+"""Megatron-style sharded compute layers, GSPMD-native.
+
+Capability-parity with the reference's
+``src/neuronx_distributed/parallel_layers/layers.py`` —
+``ColumnParallelLinear`` (:460), ``RowParallelLinear`` (:637),
+``ParallelEmbedding`` (:101) — and ``modules/qkv_linear.py``
+(``GQAQKVColumnParallelLinear``:454), re-designed for TPU:
+
+* Weight sharding is *declared* (``nn.with_partitioning`` → PartitionSpec)
+  instead of materialized per-rank; XLA GSPMD emits the collectives. The
+  reference's ``LinearWithAsyncCommunication`` (layers.py:288-417) — manual
+  async all-reduce of input grads overlapped with weight-grad matmuls — is
+  exactly what XLA's latency-hiding scheduler does for the same sharding, so
+  that 130-line autograd function dissolves into an annotation.
+* Sequence parallelism (reference layers.py:312-318,370-407,794-797) becomes
+  a pair of activation sharding constraints: seq-sharded in, seq-sharded out;
+  GSPMD inserts the all-gather before the column matmul and the
+  reduce-scatter after the row matmul.
+* ``gather_output``/``input_is_parallel`` keep their reference meanings but
+  act by choosing the output/input activation spec.
+
+Initialization matches the reference's ``_initialize_parameter_cpu``
+(layers.py:71-99) semantics: the *full* (unsharded) weight is initialized
+with a single RNG stream and then sharded, so TP degree does not change
+initial values — on TPU we simply initialize the global array and let GSPMD
+scatter it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.mesh import DP_AXES, TP_AXIS
+from neuronx_distributed_tpu.parallel.partitioning import (
+    ACT_FULL,
+    ACT_SP,
+    ACT_TP,
+    constrain,
+)
+
+Dtype = Any
+Initializer = Callable[..., jax.Array]
+
+default_kernel_init = nn.initializers.lecun_normal()
+default_embed_init = nn.initializers.normal(stddev=1.0)
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Reference ``parallel_layers/utils.py:90`` ``divide`` with the same
+    divisibility contract."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+    return numerator // denominator
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with output features sharded over TP (reference layers.py:460).
+
+    Y = X W + b, W partitioned ``(None, "tp")``. With ``gather_output=False``
+    the output activation stays TP-sharded on the feature dim (feeding a
+    RowParallelLinear); with ``sequence_parallel=True`` the input is
+    seq-sharded and GSPMD all-gathers it into the matmul.
+    """
+
+    features: int
+    use_bias: bool = True
+    gather_output: bool = False
+    sequence_parallel: bool = False
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    bias_init: Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, TP_AXIS)),
+            (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.with_partitioning(self.bias_init, (TP_AXIS,)), (self.features,), self.param_dtype
+            )
+        if self.sequence_parallel:
+            x = constrain(x, ACT_SP)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = x @ kernel
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        y = constrain(y, ACT_FULL if self.gather_output else ACT_TP)
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with input features sharded over TP (reference layers.py:637).
+
+    W partitioned ``("tp", None)``; the matmul produces partial sums that
+    GSPMD all-reduces (or reduce-scatters into seq shards when
+    ``sequence_parallel=True`` — reference layers.py:794-801).
+    """
+
+    features: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel: bool = False
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    bias_init: Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (TP_AXIS, None)),
+            (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = None
+        if self.use_bias:
+            # bias is replicated; added once after the reduction
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+        if self.input_is_parallel:
+            x = constrain(x, ACT_TP)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = x @ kernel
+        y = constrain(y, ACT_SP if self.sequence_parallel else ACT_FULL)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class ParallelEmbedding(nn.Module):
+    """Embedding table sharded over TP (reference ``ParallelEmbedding``,
+    layers.py:101). ``shard_over="vocab"`` partitions rows (reference's
+    vocab-parallel path with masked lookup + all-reduce — GSPMD derives the
+    same masked-gather + all-reduce from the sharding); ``"dim"`` partitions
+    the embedding dim.
+    """
+
+    num_embeddings: int
+    features: int
+    shard_over: str = "vocab"  # "vocab" | "dim"
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    embedding_init: Initializer = default_embed_init
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        axes = (TP_AXIS, None) if self.shard_over == "vocab" else (None, TP_AXIS)
+        embedding = self.param(
+            "embedding",
+            nn.with_partitioning(self.embedding_init, axes),
+            (self.num_embeddings, self.features),
+            self.param_dtype,
+        )
+        (embedding,) = nn.dtypes.promote_dtype(embedding, dtype=self.dtype)
+        y = jnp.take(embedding, ids, axis=0)
+        return constrain(y, ACT_FULL if self.shard_over == "vocab" else ACT_TP)
+
+
+class GQAQKVColumnParallelLinear(nn.Module):
+    """Fused Q,K,V projection with grouped-query attention and KV-head
+    replication (reference ``modules/qkv_linear.py:454``; replication logic
+    ``_initialize_kv_group``:34, ``kv_size_multiplier``).
+
+    When ``num_kv_heads`` does not divide TP, the reference replicates each KV
+    head ``kv_size_multiplier`` times so every rank owns whole heads, then
+    averages the replicated grads over a KV-shared group
+    (qkv_linear.py:250-273). Here the *stored* K/V kernels keep the compact
+    ``num_kv_heads`` layout; the forward ``jnp.repeat``s heads to the
+    replicated layout, so autodiff *sums* cotangents over copies — the
+    mathematically exact treatment the reference's group-average approximates.
+    """
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    use_bias: bool = False
+    sequence_parallel: bool = False
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    kv_size_multiplier: int = 1  # replicate KV heads so (kv*mult) % tp == 0
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        hidden = x.shape[-1]
+        q_kernel = self.param(
+            "q_kernel",
+            nn.with_partitioning(self.kernel_init, (None, TP_AXIS, None)),
+            (hidden, self.num_heads, self.head_dim),
+            self.param_dtype,
+        )
+        k_kernel = self.param(
+            "k_kernel",
+            nn.with_partitioning(self.kernel_init, (None, TP_AXIS, None)),
+            (hidden, self.num_kv_heads * self.kv_size_multiplier, self.head_dim),
+            self.param_dtype,
+        )
+        v_kernel = self.param(
+            "v_kernel",
+            nn.with_partitioning(self.kernel_init, (None, TP_AXIS, None)),
+            (hidden, self.num_kv_heads * self.kv_size_multiplier, self.head_dim),
+            self.param_dtype,
+        )
+        if self.sequence_parallel:
+            x = constrain(x, ACT_SP)
+        x, q_kernel, k_kernel, v_kernel = nn.dtypes.promote_dtype(
+            x, q_kernel, k_kernel, v_kernel, dtype=self.dtype
+        )
+        q = jnp.einsum("bsh,hnd->bsnd", x, q_kernel)
+        k = jnp.einsum("bsh,hnd->bsnd", x, k_kernel)
+        v = jnp.einsum("bsh,hnd->bsnd", x, v_kernel)
+        spec = P(DP_AXES, None, TP_AXIS, None)
+        return constrain(q, spec), constrain(k, spec), constrain(v, spec)
+
+
+class SPLayerNorm(nn.Module):
+    """LayerNorm used inside sequence-parallel regions (reference
+    ``parallel_layers/layer_norm.py:17``). The reference tags its params
+    ``sequence_parallel_enabled`` so the optimizer all-reduces their grads
+    over TP (grads.py:313-329); under GSPMD replicated params get summed
+    cotangents automatically, so only the activation constraint remains."""
+
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.sequence_parallel:
+            x = constrain(x, ACT_SP)
+        return nn.LayerNorm(
+            epsilon=self.epsilon, dtype=self.dtype, param_dtype=self.param_dtype, name="ln"
+        )(x)
+
+
+class RMSNorm(nn.Module):
+    """RMSNorm with optional sequence-parallel activation constraint (the
+    reference reuses HF's LlamaRMSNorm in its examples,
+    examples/training/llama/modeling_llama_nxd.py)."""
+
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.sequence_parallel:
+            x = constrain(x, ACT_SP)
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],), self.param_dtype)
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.epsilon)
+        y = y.astype(self.dtype or x.dtype)
+        return y * scale.astype(y.dtype)

@@ -1,0 +1,35 @@
+"""Parallel RNG discipline (reference ``parallel_layers/random.py`` —
+``XLARNGStatesTracker``:20, ``model_parallel_xla_manual_seed``:100).
+
+The reference forks named CPU/XLA RNG states so TP ranks draw *different*
+dropout/init noise while DP replicas agree. JAX's explicit keys make this a
+one-liner discipline instead of a stateful tracker:
+
+* **GSPMD path**: use one global key; JAX's partitionable threefry generates
+  sharded random bits consistently under jit, so dropout masks differ across
+  the (sharded) activation and agree across replicas by construction.
+* **shard_map path**: fold the mesh-axis rank into the key with
+  :func:`fold_in_axis_rank` — the equivalent of the reference's
+  tensor-model-parallel seed offset (random.py:100-127, seed + 2718 * tp_rank).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from neuronx_distributed_tpu.parallel.mesh import TP_AXIS
+
+# same role as the reference's fixed offset constant (random.py:107)
+_TENSOR_PARALLEL_SEED_OFFSET = 2718
+
+
+def fold_in_axis_rank(key: jax.Array, axis_name=TP_AXIS) -> jax.Array:
+    """Distinct key per shard along ``axis_name`` (inside shard_map)."""
+    return jax.random.fold_in(key, _TENSOR_PARALLEL_SEED_OFFSET + lax.axis_index(axis_name))
+
+
+def data_parallel_consistent_key(key: jax.Array) -> jax.Array:
+    """Identity — DP replicas share the key (the reference keeps the default
+    state for DP-consistent draws, random.py:111-115)."""
+    return key

@@ -1,0 +1,14 @@
+"""Optimizer subsystem: ZeRO-1 optimizer-state sharding, fp32-master AdamW.
+
+Reference: ``optimizer/zero_redundancy_optimizer.py`` (NeuronZero1Optimizer:29,
+NeuronEPZero1Optimizer:158), ``utils/adamw_fp32_optim_params.py``
+(AdamW_FP32OptimParams:31).
+"""
+
+from neuronx_distributed_tpu.optimizer.zero1 import (  # noqa: F401
+    zero1_param_spec,
+    zero1_opt_state_specs,
+    Zero1Plan,
+    make_zero1_plan,
+)
+from neuronx_distributed_tpu.optimizer.adamw import adamw_fp32_master  # noqa: F401

@@ -1,0 +1,73 @@
+"""AdamW with fp32 master params for bf16 training.
+
+Reference: ``utils/adamw_fp32_optim_params.py`` (``AdamW_FP32OptimParams``:31,
+``step``:91) — AdamW that stashes an fp32 copy of each bf16 param in optimizer
+state, updates the fp32 copy, and writes the bf16 cast back to the param.
+
+The optax formulation keeps the same state layout (mu, nu, master) but as a
+``GradientTransformation`` so it composes with clipping/schedules and so the
+master copy shards under the ZeRO-1 plan like any other state leaf.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FP32MasterState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+    master: optax.Params  # fp32 copies of the (possibly bf16) params
+
+
+def adamw_fp32_master(
+    learning_rate: optax.ScalarOrSchedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> optax.GradientTransformation:
+    """AdamW updating an fp32 master copy; emitted updates are exact in the
+    param dtype: ``update = cast(master_new) - param_old`` so ``params +
+    updates`` reproduces the bf16 cast of the fp32 master (reference
+    adamw_fp32_optim_params.py:91-155)."""
+
+    def init_fn(params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)  # noqa: E731
+        return FP32MasterState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+            master=master,
+        )
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("adamw_fp32_master requires params")
+        # schedules see the pre-increment count (optax convention: first
+        # update uses step 0), bias correction uses the post-increment count
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+        count = state.count + 1
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), updates)
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g32)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, g32)
+        c = count.astype(jnp.float32)
+        bc1 = 1 - b1**c
+        bc2 = 1 - b2**c
+
+        def step(master, m, v):
+            mhat = m / bc1
+            vhat = v / bc2
+            return master - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * master)
+
+        master = jax.tree.map(step, state.master, mu, nu)
+        new_updates = jax.tree.map(lambda mst, p: mst.astype(p.dtype) - p, master, params)
+        return new_updates, FP32MasterState(count=count, mu=mu, nu=nu, master=master)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,172 @@
+"""ZeRO-1 as optimizer-state sharding over the DP mesh axes.
+
+TPU-native re-design of the reference's
+``optimizer/zero_redundancy_optimizer.py`` (``NeuronZero1Optimizer``:29,
+``NeuronEPZero1Optimizer``:158) and of the torch-xla
+``ZeroRedundancyOptimizer`` machinery it subclasses (SURVEY §2.2: that class
+must be rebuilt for TPU).
+
+The reference implements ZeRO-1 operationally: reduce-scatter grads over the
+DP group, run the optimizer on the local 1/DP shard, all-gather updated
+params. Under GSPMD the *same dataflow* is obtained declaratively: give every
+optimizer-state tensor (Adam mu/nu, fp32 master copy) a ``PartitionSpec``
+that additionally shards one dimension over the DP axes. XLA's SPMD
+partitioner then lowers the grad consumption into a reduce-scatter, runs the
+elementwise Adam update on 1/DP of the state, and all-gathers the updated
+params where the (replicated-over-DP) params are next used — exactly the
+ZeRO-1 schedule, chosen and overlapped by the compiler.
+
+EP composition (reference ``NeuronEPZero1Optimizer`` running two sharding
+schemes over EDP and EMP) is likewise positional: expert params already carry
+the ``ep`` axis in their own spec, so their state shards over the remaining
+``edp`` axis only — :func:`zero1_param_spec` computes that per-param from the
+axes the param spec already uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.mesh import DP_AXES
+
+PyTree = Any
+
+
+def _spec_entries(spec: Optional[P], ndim: int) -> list:
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (ndim - len(entries))
+    return entries
+
+
+def _entry_axes(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def zero1_param_spec(
+    spec: Optional[P],
+    shape: Sequence[int],
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> P:
+    """Augment a param's PartitionSpec so its optimizer state also shards over
+    the DP axes (the ZeRO-1 shard).
+
+    Picks the first dimension that stays divisible after adding the DP axes —
+    preferring unsharded dims (cheap all-gather layout), then extending an
+    already-sharded dim. Falls back to the original spec (replicated state)
+    when nothing divides, mirroring the reference's behavior for tiny params
+    (torch-xla ZeRO pads; we replicate instead — the bytes are negligible).
+    """
+    mesh = mesh or ps.get_mesh()
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = _spec_entries(spec, len(shape))
+    used = {ax for e in entries for ax in _entry_axes(e)}
+    dp_axes = tuple(ax for ax in DP_AXES if ax not in used and axis_sizes.get(ax, 1) > 1)
+    if not dp_axes:
+        return P(*entries) if any(e is not None for e in entries) else P()
+    dp_size = 1
+    for ax in dp_axes:
+        dp_size *= axis_sizes[ax]
+
+    def divisor(entry) -> int:
+        d = 1
+        for ax in _entry_axes(entry):
+            d *= axis_sizes.get(ax, 1)
+        return d
+
+    # pass 1: unsharded dims; pass 2: extend sharded dims
+    for want_unsharded in (True, False):
+        for i, dim in enumerate(shape):
+            e = entries[i]
+            if want_unsharded != (e is None):
+                continue
+            if dim % (divisor(e) * dp_size) == 0:
+                entries[i] = _entry_axes(e) + dp_axes if e is not None else (
+                    dp_axes if len(dp_axes) > 1 else dp_axes[0]
+                )
+                return P(*entries)
+    return P(*entries) if any(e is not None for e in entries) else P()
+
+
+def zero1_opt_state_specs(param_specs: PyTree, params: PyTree, mesh=None) -> PyTree:
+    """Map a param-spec pytree to ZeRO-1 state specs, leaf-by-leaf."""
+    return jax.tree.map(
+        lambda spec, p: zero1_param_spec(spec, p.shape, mesh),
+        param_specs,
+        params,
+        is_leaf=lambda x: isinstance(x, P) or x is None,
+    )
+
+
+@dataclasses.dataclass
+class Zero1Plan:
+    """Shardings for a jitted train step: params keep their TP/EP specs and
+    stay DP-replicated; optimizer state additionally shards over DP."""
+
+    param_shardings: PyTree
+    opt_state_shardings_fn: Any  # (opt_state) -> sharding pytree
+
+    def opt_state_shardings(self, opt_state: PyTree) -> PyTree:
+        return self.opt_state_shardings_fn(opt_state)
+
+
+def make_zero1_plan(param_specs: PyTree, params: PyTree, mesh=None, augment: bool = True) -> Zero1Plan:
+    """Build the ZeRO-1 sharding plan.
+
+    ``opt_state_shardings_fn`` maps any optax state pytree whose array leaves
+    are param-shaped (mu, nu, master copies) to the ZeRO specs, and leaves
+    scalar counters replicated. With ``augment=False`` the state simply
+    mirrors the params' own TP/EP shardings (ZeRO disabled — state sharded
+    like params, as the reference's non-ZeRO path keeps per-rank state for
+    per-rank params)."""
+    mesh = mesh or ps.get_mesh()
+    if augment:
+        zspecs = zero1_opt_state_specs(param_specs, params, mesh)
+    else:
+        zspecs = jax.tree.map(
+            lambda s: s if isinstance(s, P) else P(),
+            param_specs,
+            is_leaf=lambda x: isinstance(x, P) or x is None,
+        )
+    # Optax states embed copies of the param tree inside their own containers,
+    # so a state leaf's path ends with the full path of its param. Match by
+    # LONGEST path suffix (ambiguity-free: if param X's full path is a suffix
+    # of the leaf path, any other matching param's path is a shorter suffix of
+    # X's), and require shape equality as a guard.
+    flat_params = jax.tree_util.tree_leaves_with_path(params)
+    entries = sorted(
+        (
+            (jax.tree_util.keystr(kp), p.shape, s)
+            for (kp, p), s in zip(
+                flat_params, jax.tree_util.tree_leaves(zspecs, is_leaf=lambda x: isinstance(x, P))
+            )
+        ),
+        key=lambda e: -len(e[0]),
+    )
+
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
+        param_specs,
+        is_leaf=lambda x: isinstance(x, P) or x is None,
+    )
+
+    def opt_state_shardings_fn(opt_state: PyTree) -> PyTree:
+        def leaf_sharding(path, leaf):
+            key = jax.tree_util.keystr(path)
+            shape = getattr(leaf, "shape", None)
+            for ppath, pshape, spec in entries:
+                if key.endswith(ppath) and shape == pshape:
+                    return NamedSharding(mesh, spec)
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)
+
+    return Zero1Plan(param_shardings=param_shardings, opt_state_shardings_fn=opt_state_shardings_fn)

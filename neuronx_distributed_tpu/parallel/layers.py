@@ -200,16 +200,24 @@ class GQAQKVColumnParallelLinear(nn.Module):
             (hidden, self.num_heads, self.head_dim),
             self.param_dtype,
         )
+        # K/V kernels stored COMPACT (num_kv_heads, like the reference's
+        # checkpoint layout); replication to kv*mult happens in the forward
+        # via jnp.repeat, so autodiff sums cotangents over the copies — the
+        # exact treatment the reference's KV-shared-group average approximates
+        # (qkv_linear.py:250-273). Compact kernels shard over TP only when
+        # num_kv_heads divides TP; otherwise they stay replicated and the
+        # repeated activations are TP-sharded instead.
+        kv_axes = (None, TP_AXIS, None) if self.kv_size_multiplier == 1 else (None, None, None)
         k_kernel = self.param(
             "k_kernel",
-            nn.with_partitioning(self.kernel_init, (None, TP_AXIS, None)),
-            (hidden, self.num_kv_heads * self.kv_size_multiplier, self.head_dim),
+            nn.with_partitioning(self.kernel_init, kv_axes),
+            (hidden, self.num_kv_heads, self.head_dim),
             self.param_dtype,
         )
         v_kernel = self.param(
             "v_kernel",
-            nn.with_partitioning(self.kernel_init, (None, TP_AXIS, None)),
-            (hidden, self.num_kv_heads * self.kv_size_multiplier, self.head_dim),
+            nn.with_partitioning(self.kernel_init, kv_axes),
+            (hidden, self.num_kv_heads, self.head_dim),
             self.param_dtype,
         )
         if self.sequence_parallel:
@@ -217,6 +225,9 @@ class GQAQKVColumnParallelLinear(nn.Module):
         x, q_kernel, k_kernel, v_kernel = nn.dtypes.promote_dtype(
             x, q_kernel, k_kernel, v_kernel, dtype=self.dtype
         )
+        if self.kv_size_multiplier > 1:
+            k_kernel = jnp.repeat(k_kernel, self.kv_size_multiplier, axis=1)
+            v_kernel = jnp.repeat(v_kernel, self.kv_size_multiplier, axis=1)
         q = jnp.einsum("bsh,hnd->bsnd", x, q_kernel)
         k = jnp.einsum("bsh,hnd->bsnd", x, k_kernel)
         v = jnp.einsum("bsh,hnd->bsnd", x, v_kernel)

@@ -1,0 +1,7 @@
+"""Trainer API (reference ``trainer/`` — config, model/optimizer wrappers,
+train step). See SURVEY.md §1 L6."""
+
+from neuronx_distributed_tpu.trainer.config import neuronx_distributed_config  # noqa: F401
+from neuronx_distributed_tpu.trainer.model import ParallelModel, initialize_parallel_model  # noqa: F401
+from neuronx_distributed_tpu.trainer.optimizer import NxDOptimizer, initialize_parallel_optimizer  # noqa: F401
+from neuronx_distributed_tpu.trainer.step import TrainState, create_train_state, make_train_step  # noqa: F401

@@ -1,0 +1,79 @@
+"""Optimizer wrapper + factory (reference ``trainer/optimizer.py``
+``NxDOptimizer``:10 and ``trainer/trainer.py`` ``initialize_parallel_optimizer``
+:232).
+
+The reference's ``NxDOptimizer.step`` pipeline (SP LayerNorm-grad all-reduce →
+DP bucket all-reduce → clip → inner step) becomes a gradient-transformation
+chain evaluated inside the jitted train step; the DP reduction and SP
+param-grad sums are emitted by the SPMD partitioner (see
+``parallel/grads.py`` docstring), so only clipping and the inner optimizer
+remain explicit. ZeRO-1 is a sharding *plan* applied to the optimizer state
+(``optimizer/zero1.py``), not a different optimizer class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import optax
+
+from neuronx_distributed_tpu.optimizer.adamw import adamw_fp32_master
+from neuronx_distributed_tpu.optimizer.zero1 import Zero1Plan, make_zero1_plan
+from neuronx_distributed_tpu.trainer.model import ParallelModel
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class NxDOptimizer:
+    """Holds the optax transformation, its (possibly ZeRO-sharded) state
+    shardings, and grad-clipping config. ``grad_norm`` is reported from the
+    train step's metrics (reference trainer/optimizer.py:137-143)."""
+
+    tx: optax.GradientTransformation
+    grad_clipping: bool
+    max_grad_norm: float
+    zero1_plan: Zero1Plan
+
+    def init(self, params: PyTree) -> PyTree:
+        return self.tx.init(params)
+
+    def opt_state_shardings(self, opt_state: PyTree):
+        return self.zero1_plan.opt_state_shardings(opt_state)
+
+
+def initialize_parallel_optimizer(
+    nxd_config: Dict[str, Any],
+    model: ParallelModel,
+    tx: Optional[optax.GradientTransformation] = None,
+    learning_rate: Any = 1e-4,
+    weight_decay: float = 0.01,
+    **adam_kwargs,
+) -> NxDOptimizer:
+    """Build the optimizer per config (reference trainer/trainer.py:232-283).
+
+    Default inner optimizer is fp32-master AdamW when
+    ``mixed_precision_config.use_master_weights`` (reference chooses
+    AdamW_FP32OptimParams under the same flag, trainer.py:250-256); pass
+    ``tx`` to supply any optax transformation instead.
+    """
+    opt_cfg = nxd_config["optimizer_config"]
+    mp_cfg = nxd_config["mixed_precision_config"]
+    if tx is None:
+        if mp_cfg["use_master_weights"]:
+            tx = adamw_fp32_master(learning_rate, weight_decay=weight_decay, **adam_kwargs)
+        else:
+            tx = optax.adamw(learning_rate, weight_decay=weight_decay, **adam_kwargs)
+    # always a plan: ZeRO augments state specs with DP axes; otherwise state
+    # mirrors the params' own TP/EP shardings (never blindly replicated)
+    plan = make_zero1_plan(
+        model.param_specs, model.params, model.mesh, augment=opt_cfg["zero_one_enabled"]
+    )
+    return NxDOptimizer(
+        tx=tx,
+        grad_clipping=opt_cfg["grad_clipping"],
+        max_grad_norm=float(opt_cfg["max_grad_norm"]),
+        zero1_plan=plan,
+    )

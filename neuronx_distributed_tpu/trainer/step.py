@@ -1,0 +1,95 @@
+"""The jitted training step: one compiled XLA program per step.
+
+Reference call stack (SURVEY §3.2): ``NxDModel.run_train`` → forward →
+``loss.backward()`` → ``NxDOptimizer.step`` → ``xm.mark_step()``, where the
+mark_step fuses the whole step into one XLA program. On TPU/JAX the jitted
+``train_step`` IS that program — forward, backward, grad clip, optimizer
+update, all scheduled together by XLA, with buffer donation replacing the
+reference's manual memory management.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import optax
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.grads import clip_grad_norm
+from neuronx_distributed_tpu.trainer.model import ParallelModel
+from neuronx_distributed_tpu.trainer.optimizer import NxDOptimizer
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter + params + optimizer state (the reference keeps these on
+    the model/optimizer objects; functional JAX keeps them in one pytree that
+    the step consumes and re-emits with donated buffers)."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+
+
+def create_train_state(model: ParallelModel, optimizer: NxDOptimizer) -> TrainState:
+    """Initialize optimizer state sharded per the ZeRO-1 plan (state is born
+    sharded, like params — no scatter after the fact)."""
+    opt_state = jax.jit(
+        optimizer.init, out_shardings=_opt_state_shardings(model, optimizer)
+    )(model.params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=model.params, opt_state=opt_state)
+
+
+def _opt_state_shardings(model: ParallelModel, optimizer: NxDOptimizer):
+    abstract = jax.eval_shape(optimizer.init, model.params)
+    return optimizer.zero1_plan.opt_state_shardings(abstract)
+
+
+def make_train_step(
+    model: ParallelModel,
+    optimizer: NxDOptimizer,
+    loss_fn: Callable[..., jax.Array],
+    donate: bool = True,
+) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the jitted step.
+
+    ``loss_fn(params, batch, rng) -> scalar loss`` must call
+    ``model.apply`` inside; the batch should be sharded over the DP mesh axes
+    (use ``mesh.data_pspec()``) — GSPMD then emits the DP grad all-reduce
+    inside this same program (reference ``bucket_allreduce_gradients``
+    equivalence, see parallel/grads.py).
+    """
+    mesh = model.mesh
+    param_shardings = model.param_shardings()
+
+    def step_fn(state: TrainState, batch: PyTree, rng: jax.Array):
+        grad_fn = jax.value_and_grad(loss_fn)
+        loss, grads = grad_fn(state.params, batch, rng)
+        metrics = {"loss": loss}
+        if optimizer.grad_clipping:
+            grads, grad_norm = clip_grad_norm(grads, optimizer.max_grad_norm)
+            metrics["grad_norm"] = grad_norm
+        updates, new_opt_state = optimizer.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        return new_state, metrics
+
+    # Pin state shardings so ZeRO-1 state stays DP-sharded across steps and
+    # params stay on their TP/EP layout; donate the old state buffers.
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=param_shardings,
+        opt_state=_opt_state_shardings(model, optimizer),
+    )
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, None, None),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )

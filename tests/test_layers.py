@@ -117,9 +117,12 @@ def test_gqa_qkv_shapes_and_values():
     assert q.shape == (2, 8, 8, 16)
     assert k.shape == (2, 8, 4, 16)
     assert v.shape == (2, 8, 4, 16)
+    # stored kernel is COMPACT (num_kv_heads); forward repeats heads to kv*mult
     kk = np.asarray(params["params"]["k_kernel"])
+    assert kk.shape == (64, 2, 16)
+    kk_rep = np.repeat(kk, 2, axis=1)
     np.testing.assert_allclose(
-        np.asarray(k), np.einsum("bsh,hnd->bsnd", np.asarray(x), kk), rtol=1e-4, atol=1e-5
+        np.asarray(k), np.einsum("bsh,hnd->bsnd", np.asarray(x), kk_rep), rtol=1e-4, atol=1e-5
     )
 
 

@@ -1,0 +1,104 @@
+"""End-to-end trainer tests on the 8-device CPU mesh.
+
+Mirrors the reference's minimum slice (SURVEY §7.2): a 2-layer
+ColumnParallel→RowParallel MLP trained with the full stack (config → sharded
+init → ZeRO-1 AdamW → jitted step), checked for loss-trajectory parity
+against a single-device dense run — the reference's golden-vs-control
+methodology (test/integration/common/integration_test_utils.py:54-157).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.optimizer.zero1 import zero1_param_spec
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear, RowParallelLinear
+from neuronx_distributed_tpu.trainer import (
+    create_train_state,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+    neuronx_distributed_config,
+)
+
+
+class ParallelMLP(nn.Module):
+    hidden: int = 32
+    ffn: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = ColumnParallelLinear(features=self.ffn, name="up")(x)
+        x = nn.gelu(x)
+        x = RowParallelLinear(features=self.hidden, name="down")(x)
+        return x
+
+
+def _loss_fn_builder(model):
+    def loss_fn(params, batch, rng):
+        out = model.apply(params, batch["x"])
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    return loss_fn
+
+
+def _train(tp, zero1, steps=5, use_master=True):
+    cfg = neuronx_distributed_config(
+        tensor_parallel_size=tp,
+        optimizer_config={"zero_one_enabled": zero1, "grad_clipping": True, "max_grad_norm": 1.0},
+        mixed_precision_config={"use_master_weights": use_master},
+    )
+    x = np.random.RandomState(0).randn(16, 8, 32).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 8, 32).astype(np.float32)
+    model = initialize_parallel_model(cfg, ParallelMLP, jnp.zeros((16, 8, 32)))
+    opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-2, weight_decay=0.0)
+    state = create_train_state(model, opt)
+    step = make_train_step(model, opt, _loss_fn_builder(model))
+    losses = []
+    rng = jax.random.key(42)
+    for _ in range(steps):
+        state, metrics = step(state, {"x": x, "y": y}, rng)
+        losses.append(float(metrics["loss"]))
+    ps.destroy_model_parallel()
+    return losses
+
+
+def test_tp_zero1_matches_dense_trajectory():
+    ref = _train(tp=1, zero1=False)
+    got = _train(tp=4, zero1=True)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    assert got[-1] < got[0]  # actually learning
+
+
+def test_plain_adamw_path():
+    losses = _train(tp=2, zero1=False, use_master=False, steps=3)
+    assert losses[-1] < losses[0]
+
+
+def test_zero1_param_spec_assignment():
+    ps.initialize_model_parallel(tensor_model_parallel_size=2)  # dp=4 → edp=4
+    # unsharded 2D param: first divisible dim gets the DP axes
+    assert zero1_param_spec(P(None, None), (64, 32)) == P("edp", None)
+    # TP-sharded dim extended when divisible, else other dim used
+    assert zero1_param_spec(P(None, "tp"), (64, 32)) == P("edp", "tp")
+    # nothing divides → replicated state
+    assert zero1_param_spec(P(None), (3,)) == P()
+
+
+def test_zero1_state_is_dp_sharded():
+    cfg = neuronx_distributed_config(tensor_parallel_size=2)
+    model = initialize_parallel_model(cfg, ParallelMLP, jnp.zeros((4, 8, 32)))
+    opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-3)
+    state = create_train_state(model, opt)
+    # find the mu tree: every param-shaped leaf must have >1 shard groups
+    mu = state.opt_state.mu
+    leaf = jax.tree_util.tree_leaves(mu)[0]
+    # sharded over edp(4) somewhere → number of distinct shards > tp alone
+    ndevs_with_data = len({s.index for s in leaf.addressable_shards})
+    assert ndevs_with_data > 2, f"opt state not ZeRO-sharded: {leaf.sharding}"
+    ps.destroy_model_parallel()

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (reference ``kernels/`` — NKI flash attention glue,
+``kernels/flash_attn.py``). Here the kernels are implemented in-repo with
+Pallas instead of delegating to an external compiler package."""
+
+from neuronx_distributed_tpu.kernels.flash_attn import flash_attention  # noqa: F401

@@ -1,0 +1,282 @@
+"""Llama-2/3 model family, TP/SP-parallel, flash-attention, scan-over-layers.
+
+Capability-parity with the reference's Llama modeling
+(``examples/training/llama/modeling_llama_nxd.py`` — TP attention with
+``GQAQKVColumnParallelLinear`` at :238-340, ColumnParallel gate/up +
+RowParallel down MLP, sequence-parallel norms) re-designed for TPU:
+
+* one flax module tree; weights declare their sharding
+  (``nn.with_partitioning``), GSPMD places the TP collectives;
+* decoder layers run under ``nn.scan`` so XLA compiles ONE layer body
+  regardless of depth (the reference re-traces all layers into one graph);
+* activation checkpointing is ``nn.remat`` with a jax checkpoint policy
+  (reference ``utils/activation_checkpoint.py`` predicate wrapping →
+  ``remat_policy`` config: "full" | "attention" | None, SURVEY §5.7's
+  selective-checkpoint levers);
+* attention runs the Pallas flash kernel via ``ops.attention`` (the
+  reference's NKI kernel seam).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.ops.attention import attention
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    GQAQKVColumnParallelLinear,
+    ParallelEmbedding,
+    RMSNorm,
+    RowParallelLinear,
+)
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy_mean
+from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, ACT_SP, constrain
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16        # compute dtype (mixed_precision_config.compute_dtype)
+    param_dtype: Any = jnp.float32   # storage dtype (master weights live in optimizer)
+    sequence_parallel: bool = False
+    use_flash_attention: bool = True
+    attention_block_q: int = 128
+    attention_block_k: int = 128
+    remat_policy: Optional[str] = "full"  # None | "full" | "attention"
+    kv_size_multiplier: int = 1
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+# presets mirroring the reference's example configs (BASELINE.md ladder)
+def llama2_7b(**over) -> LlamaConfig:
+    return LlamaConfig(hidden_size=4096, intermediate_size=11008, num_layers=32,
+                       num_heads=32, num_kv_heads=32, **over)
+
+
+def llama2_13b(**over) -> LlamaConfig:
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824, num_layers=40,
+                       num_heads=40, num_kv_heads=40, **over)
+
+
+def llama2_70b(**over) -> LlamaConfig:
+    return LlamaConfig(hidden_size=8192, intermediate_size=28672, num_layers=80,
+                       num_heads=64, num_kv_heads=8, **over)
+
+
+def llama3_8b(**over) -> LlamaConfig:
+    return LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                       num_layers=32, num_heads=32, num_kv_heads=8,
+                       rope_theta=500000.0, **over)
+
+
+def rotary_embedding(positions: jax.Array, head_dim: int, theta: float,
+                     dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions, (seq, head_dim/2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., s, d/2)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (x1, x2) — x is (b, s, n, d); cos/sin (s, d/2) or (b, s, d/2)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        hd = cfg.head_dim_
+        q, k, v = GQAQKVColumnParallelLinear(
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=hd,
+            kv_size_multiplier=cfg.kv_size_multiplier,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="qkv",
+        )(x)
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        # BSND -> BHSD for the kernel
+        o = attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+            causal=True,
+            use_flash=cfg.use_flash_attention,
+            block_q=cfg.attention_block_q,
+            block_k=cfg.attention_block_k,
+        )
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
+        return RowParallelLinear(
+            cfg.hidden_size, use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="o_proj",
+        )(o)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        gate = ColumnParallelLinear(
+            cfg.intermediate_size, use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="gate_proj",
+        )(x)
+        up = ColumnParallelLinear(
+            cfg.intermediate_size, use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="up_proj",
+        )(x)
+        return RowParallelLinear(
+            cfg.hidden_size, use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="down_proj",
+        )(nn.silu(gate) * up)
+
+
+class LlamaDecoderLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
+        x = x + LlamaAttention(cfg, name="attention")(h, positions)
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
+        return x + LlamaMLP(cfg, name="mlp")(h)
+
+
+def _remat_policy(name: Optional[str]):
+    if name is None:
+        return None
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "attention":
+        # save the big matmul outputs, recompute elementwise — the selective
+        # checkpoint choice of the reference at long seq (run_llama_nxd.py:113)
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
+class _LayerStep(nn.Module):
+    """Scan body: one (optionally remat-wrapped) decoder layer returning the
+    ``(carry, ys)`` pair ``nn.scan`` expects."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        cls = LlamaDecoderLayer
+        policy = _remat_policy(cfg.remat_policy)
+        if policy is not None:
+            cls = nn.remat(cls, policy=policy, prevent_cse=False)
+        return cls(cfg, name="block")(x, positions), None
+
+
+class LlamaModel(nn.Module):
+    """Embedding + scanned decoder stack + final norm. Hidden states flow in
+    ``(batch, seq, hidden)``; SP keeps seq sharded between attention/MLP."""
+
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed = ParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, shard_over="vocab",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        # scan over layers: one compiled body, params stacked on a leading
+        # (unsharded) layer axis
+        self.layers = nn.scan(
+            _LayerStep,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.num_layers,
+            in_axes=nn.broadcast,
+            metadata_params={nn.meta.PARTITION_NAME: None},
+        )(cfg)
+        self.final_norm = RMSNorm(
+            epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel,
+        )
+
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        if input_ids.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        x = self.embed(input_ids)
+        positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
+        x, _ = self.layers(x, positions)
+        return self.final_norm(x)
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Tied-embedding logits (``tie_word_embeddings``)."""
+        return self.embed.attend(x)
+
+
+class LlamaForCausalLM(nn.Module):
+    """Model + vocab-parallel LM head (tied to the embedding when
+    ``config.tie_word_embeddings``). ``__call__`` returns (vocab-sharded)
+    logits; ``loss`` computes the vocab-parallel CE without materializing
+    gathered logits (reference ``parallel_cross_entropy`` wiring)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        model = LlamaModel(cfg, name="model")
+        x = model(input_ids)
+        if cfg.sequence_parallel:
+            x = constrain(x, ACT_FULL)
+        if cfg.tie_word_embeddings:
+            return model.attend(x.astype(jnp.float32))
+        return ColumnParallelLinear(
+            cfg.vocab_size, use_bias=False, gather_output=False,
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+
+    def loss(self, input_ids: jax.Array, labels: jax.Array,
+             ignore_index: int = -100) -> jax.Array:
+        logits = self(input_ids)
+        return parallel_cross_entropy_mean(logits, labels, ignore_index=ignore_index)

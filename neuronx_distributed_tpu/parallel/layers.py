@@ -153,18 +153,28 @@ class ParallelEmbedding(nn.Module):
     param_dtype: Dtype = jnp.float32
     embedding_init: Initializer = default_embed_init
 
-    @nn.compact
-    def __call__(self, ids: jax.Array) -> jax.Array:
+    def setup(self):
         axes = (TP_AXIS, None) if self.shard_over == "vocab" else (None, TP_AXIS)
-        embedding = self.param(
+        self.embedding = self.param(
             "embedding",
             nn.with_partitioning(self.embedding_init, axes),
             (self.num_embeddings, self.features),
             self.param_dtype,
         )
-        (embedding,) = nn.dtypes.promote_dtype(embedding, dtype=self.dtype)
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        (embedding,) = nn.dtypes.promote_dtype(self.embedding, dtype=self.dtype)
         y = jnp.take(embedding, ids, axis=0)
         return constrain(y, ACT_FULL if self.shard_over == "vocab" else ACT_TP)
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Logits against the (tied) table: ``x @ E.T`` (flax ``nn.Embed.attend``
+        counterpart, used for ``tie_word_embeddings``). Vocab-sharded tables
+        yield vocab-sharded logits — the same layout as a
+        ``gather_output=False`` ColumnParallelLinear lm_head."""
+        (embedding,) = nn.dtypes.promote_dtype(self.embedding, dtype=self.dtype)
+        y = x @ embedding.T
+        return constrain(y, ACT_TP if self.shard_over == "vocab" else ACT_FULL)
 
 
 class GQAQKVColumnParallelLinear(nn.Module):
@@ -193,6 +203,18 @@ class GQAQKVColumnParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        from neuronx_distributed_tpu.parallel import mesh as ps
+
+        if ps.model_parallel_is_initialized():
+            tp = ps.get_tensor_model_parallel_size()
+            if self.num_heads % tp != 0:
+                raise ValueError(f"num_heads {self.num_heads} not divisible by tp {tp}")
+            if (self.num_kv_heads * self.kv_size_multiplier) % tp != 0:
+                raise ValueError(
+                    f"num_kv_heads*kv_size_multiplier "
+                    f"({self.num_kv_heads}*{self.kv_size_multiplier}) must be divisible by tp {tp}; "
+                    f"raise kv_size_multiplier (reference qkv_linear.py:34-78 contract)"
+                )
         hidden = x.shape[-1]
         q_kernel = self.param(
             "q_kernel",

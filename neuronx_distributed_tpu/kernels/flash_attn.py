@@ -104,11 +104,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_scr, dv_scr,
-                     *, sm_scale, causal, block_q, block_k, q_blocks):
-    qi = pl.program_id(2)
+                     *, sm_scale, causal, block_q, block_k, q_blocks, group):
+    # grid (b*hk, kv_blocks, group, q_blocks): one dk/dv block accumulates
+    # over its GQA group's q heads AND all q blocks in consecutive grid steps
+    # (TPU output revisiting must be consecutive)
     ki = pl.program_id(1)
+    g = pl.program_id(2)
+    qi = pl.program_id(3)
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (g == 0))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -142,7 +146,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == q_blocks - 1)
+    @pl.when((qi == q_blocks - 1) & (g == group - 1))
     def _finalize():
         dk_ref[...] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[...] = dv_scr[:].astype(dv_ref.dtype)
@@ -191,13 +195,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # public op with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bh(q, k, v, causal, sm_scale, block_q, block_k):
-    out, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bh(q, k, v, causal, sm_scale, block_q, block_k, group):
+    """q: (b*h, sq, d); k/v COMPACT: (b*hk, sk, d) with group = h // hk —
+    kernels index the shared kv head via the BlockSpec index_map, so GQA
+    K/V are never materialized per-q-head in HBM."""
+    out, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, group)
     return out
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, group=1):
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -215,8 +222,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
         grid=(bh, q_blocks, kv_blocks),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -236,12 +243,12 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return out, lse
 
 
-def _flash_fwd_vjp(q, k, v, causal, sm_scale, block_q, block_k):
-    out, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash_fwd_vjp(q, k, v, causal, sm_scale, block_q, block_k, group):
+    out, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, group)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, group, res, do):
     from jax.experimental.pallas import tpu as pltpu
 
     q, k, v, out, lse = res
@@ -257,22 +264,25 @@ def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, res, do):
 
     dkdv_kernel = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, q_blocks=q_blocks,
+        block_q=block_q, block_k=block_k, q_blocks=q_blocks, group=group,
     )
+    # q row for compact kv row ``bk`` and member ``g`` is bk*group + g
+    # (bh = b*h = (b*hk)*group, heads grouped contiguously per kv head)
+    hkv = k.shape[0]  # b * hk
     dk, dv = pl.pallas_call(
         dkdv_kernel,
-        grid=(bh, kv_blocks, q_blocks),
+        grid=(hkv, kv_blocks, group, q_blocks),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bk, j, g, i: (bk * group + g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bk, j, g, i: (bk, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bk, j, g, i: (bk, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bk, j, g, i: (bk * group + g, i, 0)),
+            pl.BlockSpec((None, block_q, LANES), lambda bk, j, g, i: (bk * group + g, i, 0)),
+            pl.BlockSpec((None, block_q, LANES), lambda bk, j, g, i: (bk * group + g, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bk, j, g, i: (bk, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bk, j, g, i: (bk, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -294,8 +304,8 @@ def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, res, do):
         grid=(bh, q_blocks, kv_blocks),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -324,16 +334,14 @@ def flash_attention(
     (reference ``nki_flash_attn_func``, kernels/flash_attn.py:151 — same
     BHSD convention).
 
-    GQA: ``k``/``v`` may have fewer heads; they are repeated to match
-    (the compact-storage contract of ``GQAQKVColumnParallelLinear``).
+    GQA: ``k``/``v`` may have fewer heads; the kernels index the shared kv
+    head through the BlockSpec index_map (``row // group``), so K/V stay at
+    their compact size in HBM — no ``jnp.repeat`` materialization.
     """
     b, h, sq, d = q.shape
     hk = k.shape[1]
-    if hk != h:
-        if h % hk != 0:
-            raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
-        k = jnp.repeat(k, h // hk, axis=1)
-        v = jnp.repeat(v, h // hk, axis=1)
+    if h % hk != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     sk = k.shape[2]
@@ -350,9 +358,9 @@ def flash_attention(
             f"(bottom-aligned mask semantics)"
         )
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
-    out = _flash_attention_bh(qf, kf, vf, causal, float(sm_scale), block_q, block_k)
+    kf = k.reshape(b * hk, sk, d)
+    vf = v.reshape(b * hk, sk, d)
+    out = _flash_attention_bh(qf, kf, vf, causal, float(sm_scale), block_q, block_k, h // hk)
     return out.reshape(b, h, sq, d)
 
 

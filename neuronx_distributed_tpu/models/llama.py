@@ -113,7 +113,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, rope: Tuple[jax.Array, jax.Array]) -> jax.Array:
         cfg = self.config
         hd = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -126,7 +126,7 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
-        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype)
+        cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         # BSND -> BHSD for the kernel
@@ -172,11 +172,11 @@ class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, rope) -> jax.Array:
         cfg = self.config
         h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
-        x = x + LlamaAttention(cfg, name="attention")(h, positions)
+        x = x + LlamaAttention(cfg, name="attention")(h, rope)
         h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
         return x + LlamaMLP(cfg, name="mlp")(h)
@@ -201,13 +201,13 @@ class _LayerStep(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, rope):
         cfg = self.config
         cls = LlamaDecoderLayer
         policy = _remat_policy(cfg.remat_policy)
         if policy is not None:
             cls = nn.remat(cls, policy=policy, prevent_cse=False)
-        return cls(cfg, name="block")(x, positions), None
+        return cls(cfg, name="block")(x, rope), None
 
 
 class LlamaModel(nn.Module):
@@ -245,8 +245,10 @@ class LlamaModel(nn.Module):
             )
         x = self.embed(input_ids)
         positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        # cos/sin computed ONCE here (not per scanned layer) and broadcast
+        rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
         x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
-        x, _ = self.layers(x, positions)
+        x, _ = self.layers(x, rope)
         return self.final_norm(x)
 
     def attend(self, x: jax.Array) -> jax.Array:

@@ -113,10 +113,36 @@ def test_tied_embeddings():
     model = LlamaForCausalLM(cfg)
     variables = model.init(jax.random.PRNGKey(0), ids)
     from flax.core import meta
+    from neuronx_distributed_tpu.models.llama import LlamaModel
     params = meta.unbox(variables)["params"]
     assert "lm_head" not in params, "tied model must not create a separate lm_head"
     logits = model.apply({"params": params}, ids)
-    # logits equal x @ E.T — verify against manual compute
-    table = params["model"]["embed"]["embedding"]
     assert logits.shape == (2, 16, cfg.vocab_size)
-    assert np.isfinite(np.asarray(logits)).all()
+    # value check: logits == final_hidden @ E.T with the embedding table
+    hidden = LlamaModel(cfg).apply({"params": params["model"]}, ids)
+    table = params["model"]["embed"]["embedding"]
+    expected = np.asarray(hidden, np.float32) @ np.asarray(table, np.float32).T
+    np.testing.assert_allclose(np.asarray(logits), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_tp_flash_shard_map_path():
+    """The mesh-initialized flash path (shard_map over dp×tp with the Pallas
+    kernel) must match the dense no-flash golden — covers spec correctness,
+    per-shard GQA head alignment, and check_vma handling."""
+    ids = _ids((2, 64), 6)
+    cfg_dense = LlamaConfig(**TINY)
+    cfg_flash = LlamaConfig(**{**TINY, "use_flash_attention": True,
+                               "attention_block_q": 32, "attention_block_k": 32})
+    model_dense = LlamaForCausalLM(cfg_dense)
+    model_flash = LlamaForCausalLM(cfg_flash)
+    variables = model_dense.init(jax.random.PRNGKey(0), ids)
+    from flax.core import meta
+    dense_params = meta.unbox(variables)
+    golden = model_dense.apply(dense_params, ids)
+
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    from neuronx_distributed_tpu.parallel.partitioning import named_sharding_tree
+    sharded = jax.device_put(dense_params, named_sharding_tree(variables, st.mesh))
+    with jax.set_mesh(st.mesh):
+        out = jax.jit(model_flash.apply)(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-3, atol=2e-3)

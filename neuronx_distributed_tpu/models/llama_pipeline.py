@@ -1,0 +1,198 @@
+"""Pipeline-parallel Llama: the flagship model on the SPMD pipeline engine.
+
+Replaces the reference's ``NxDPPModel(LlamaForCausalLM)`` wrapping
+(``examples/training/llama/tp_pp_llama_hf_pretrain`` — FX trace, cut at
+decoder layers, per-rank local modules, SURVEY §3.3). Here the "partition" is
+an array layout: the scan-stacked decoder-layer params ``(L, ...)`` get their
+leading axis sharded over ``pp``; embed / final-norm / lm-head params are
+replicated over ``pp`` (the reference pins them to first/last stage — on TPU
+replication costs HBM but removes the stage-asymmetry machinery; ZeRO-1
+shards their optimizer state over DP either way).
+
+Parameter values are interchangeable with ``LlamaForCausalLM``: the layer
+tree is the same scan-stacked ``{"block": ...}`` layout, so checkpoints move
+between the PP and non-PP model by renaming top-level keys only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import meta
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaDecoderLayer,
+    rotary_embedding,
+)
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear, ParallelEmbedding, RMSNorm
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy_mean
+from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, constrain
+from neuronx_distributed_tpu.pipeline.engine import microbatch, pipeline
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class PipelinedLlama:
+    """Functional model object (init/apply/loss) — not a flax module, because
+    the pipeline engine needs raw stacked params under ``shard_map``."""
+
+    config: LlamaConfig
+    num_stages: int
+    num_microbatches: int
+    remat: bool = True
+
+    def __post_init__(self):
+        cfg = self.config
+        if cfg.num_layers % self.num_stages != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by pipeline stages {self.num_stages}"
+            )
+        if cfg.tie_word_embeddings:
+            raise NotImplementedError("tied embeddings with PP: use the non-PP model")
+        self._layer = LlamaDecoderLayer(cfg)
+        self._embed = ParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, shard_over="vocab",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        self._norm = RMSNorm(
+            epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            sequence_parallel=False,
+        )
+        self._head = ColumnParallelLinear(
+            cfg.vocab_size, use_bias=False, gather_output=False,
+            dtype=jnp.float32, param_dtype=cfg.param_dtype,
+        )
+
+    # --- init -----------------------------------------------------------
+
+    def _sample_inputs(self, sample_ids: jax.Array):
+        cfg = self.config
+        seq = sample_ids.shape[1]
+        x_sample = jnp.zeros((sample_ids.shape[0], seq, cfg.hidden_size), cfg.dtype)
+        rope = rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
+                                cfg.rope_theta, dtype=cfg.dtype)
+        return x_sample, rope
+
+    def init(self, rng: jax.Array, sample_ids: jax.Array) -> PyTree:
+        """Stacked-layer params ``(L, ...)`` + embed/norm/head params."""
+        cfg = self.config
+        r_embed, r_layers, r_norm, r_head = jax.random.split(rng, 4)
+        x_sample, rope = self._sample_inputs(sample_ids)
+        keys = jax.random.split(r_layers, cfg.num_layers)
+        stacked = jax.vmap(
+            lambda k: meta.unbox(self._layer.init(k, x_sample, rope))["params"]
+        )(keys)
+        return {
+            "embed": meta.unbox(self._embed.init(r_embed, sample_ids))["params"],
+            "layers": {"block": stacked},
+            "final_norm": meta.unbox(self._norm.init(r_norm, x_sample))["params"],
+            "lm_head": meta.unbox(self._head.init(r_head, x_sample))["params"],
+        }
+
+    def param_specs(self, sample_ids: jax.Array) -> PyTree:
+        """PartitionSpec tree: per-layer specs with ``pp`` prepended on the
+        stacked-layer axis (the stage partition IS this sharding)."""
+        x_sample, rope = self._sample_inputs(sample_ids)
+        key = jax.random.key(0)
+        layer_vars = jax.eval_shape(self._layer.init, key, x_sample, rope)
+        layer_specs = nn.get_partition_spec(layer_vars)["params"]
+        return {
+            "embed": nn.get_partition_spec(
+                jax.eval_shape(self._embed.init, key, sample_ids))["params"],
+            "layers": {"block": jax.tree.map(
+                lambda s: P(ps.PP_AXIS, *s) if isinstance(s, P) else P(ps.PP_AXIS),
+                layer_specs,
+                is_leaf=lambda x: isinstance(x, P) or x is None,
+            )},
+            "final_norm": nn.get_partition_spec(
+                jax.eval_shape(self._norm.init, key, x_sample))["params"],
+            "lm_head": nn.get_partition_spec(
+                jax.eval_shape(self._head.init, key, x_sample))["params"],
+        }
+
+    # --- forward --------------------------------------------------------
+
+    def _stage_fn(self, local_layers: PyTree, x: jax.Array, cos, sin) -> jax.Array:
+        from neuronx_distributed_tpu.models.llama import _remat_policy
+
+        policy = _remat_policy(self.config.remat_policy)
+
+        def layer_fn(layer_params, h):
+            return self._layer.apply({"params": layer_params}, h, (cos, sin))
+
+        if policy is not None:
+            # honor cfg.remat_policy per layer (same semantics as the non-PP
+            # model's _LayerStep); the engine's per-stage checkpoint is then
+            # redundant and disabled in apply()
+            layer_fn = jax.checkpoint(layer_fn, policy=policy, prevent_cse=False)
+
+        def body(h, layer_params):
+            return layer_fn(layer_params, h), None
+
+        x, _ = lax.scan(body, x, local_layers)
+        return x
+
+    def apply(self, params: PyTree, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        if input_ids.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        x = self._embed.apply({"params": params["embed"]}, input_ids)
+        seq = input_ids.shape[1]
+        cos, sin = rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
+                                    cfg.rope_theta, dtype=x.dtype)
+        x_mb = microbatch(x, self.num_microbatches)
+        run = pipeline(
+            self._stage_fn, self.num_stages, self.num_microbatches,
+            remat=self.remat and self.config.remat_policy is None,
+        )
+        y_mb = run(params["layers"]["block"], x_mb, cos, sin)
+        y = y_mb.reshape(-1, *y_mb.shape[2:])
+        y = constrain(y, ACT_FULL)
+        y = self._norm.apply({"params": params["final_norm"]}, y)
+        return self._head.apply({"params": params["lm_head"]}, y)
+
+    def loss(self, params: PyTree, input_ids: jax.Array, labels: jax.Array,
+             ignore_index: int = -100) -> jax.Array:
+        logits = self.apply(params, input_ids)
+        return parallel_cross_entropy_mean(logits, labels, ignore_index=ignore_index)
+
+    # --- trainer integration -------------------------------------------
+
+    def as_parallel_model(self, sample_ids: jax.Array, seed: int = 0):
+        """Adapter to the trainer's ParallelModel surface: sharded-init the
+        params on the mesh; the shim's ``apply`` routes through the pipeline
+        so ``make_train_step``/ZeRO-1/checkpointing work unchanged."""
+        from neuronx_distributed_tpu.trainer.model import ParallelModel
+
+        from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
+
+        mesh = ps.get_mesh()
+        specs = self.param_specs(sample_ids)
+        shardings = specs_to_shardings(specs, mesh)
+        params = jax.jit(
+            lambda: self.init(jax.random.key(seed), sample_ids), out_shardings=shardings
+        )()
+
+        outer = self
+
+        class _Shim:
+            @staticmethod
+            def apply(variables, *args, method=None, **kwargs):
+                p = variables["params"]
+                if method is None:
+                    return outer.apply(p, *args, **kwargs)
+                name = method if isinstance(method, str) else method.__name__
+                return getattr(outer, name)(p, *args, **kwargs)
+
+        return ParallelModel(module=_Shim(), params=params, param_specs=specs, mesh=mesh)

@@ -67,3 +67,16 @@ def named_sharding_tree(variables, mesh=None):
     specs = nn.get_partition_spec(variables)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda s: isinstance(s, P))
+
+
+def specs_to_shardings(specs, mesh=None):
+    """PartitionSpec tree -> NamedSharding tree; non-spec leaves (plain params
+    without partitioning metadata) map to replicated. The single source of
+    truth for this conversion — used by sharded init, the train step, and the
+    pipeline model alike."""
+    mesh = mesh or ps.get_mesh()
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
+        specs,
+        is_leaf=lambda x: isinstance(x, P) or x is None,
+    )

@@ -1,0 +1,6 @@
+"""Pipeline parallelism (reference ``pipeline/`` — NxDPPModel, schedules,
+comm; see SURVEY §1 L3). TPU-native: schedules are pure logic, the engine is
+one jitted collective-permute program (engine.py)."""
+
+from neuronx_distributed_tpu.pipeline.engine import microbatch, pipeline  # noqa: F401
+from neuronx_distributed_tpu.pipeline import schedules  # noqa: F401

@@ -1,0 +1,139 @@
+"""SPMD pipeline engine: the whole pipeline as ONE compiled XLA program.
+
+TPU-native replacement for the reference's ``pipeline/model.py``
+(``NxDPPModel``:54 — FX partition + per-task graph breaks + 2-rank-all-gather
+p2p + shape pre-negotiation over TCP, SURVEY §3.3/§5.8). None of that
+machinery survives on TPU because the constraints that forced it vanish:
+
+* p2p is a real primitive (``lax.ppermute`` over the ``pp`` mesh axis, riding
+  ICI/DCN) instead of 2-rank all-gather groups;
+* there is no per-task graph loading to order — the *entire* schedule
+  (all microbatches, forward and backward) is a single jitted program, so the
+  deadlock discipline, TCP-store shape channel, and ``mark_step`` breaks have
+  no equivalent;
+* stage partitioning is a sharding annotation: the scan-stacked layer
+  parameters get their leading (layer) axis sharded over ``pp``, so "stage s
+  owns layers [s*L/pp, (s+1)*L/pp)" is literally the array layout.
+
+Mechanism (collective-permute pipelining, the GSPMD idiom):
+``shard_map`` manual over ``pp`` only (``axis_names={"pp"}``), TP/SP/DP stay
+GSPMD-auto inside. Each of ``T = num_microbatches + pp - 1`` ticks runs the
+local stage (a ``lax.scan`` over its layer slice) and rotates activations to
+the next stage with ``ppermute``. Bubble fraction is ``(pp-1)/T`` — identical
+to 1F1B's; the backward pipeline emerges from differentiating the scan (the
+reverse program replays ticks backwards, cotangents ppermute the other way).
+Per-tick ``jax.checkpoint`` keeps live memory at one stage-activation per
+in-flight microbatch, the 1F1B memory profile.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.mesh import DP_AXES, PP_AXIS
+
+PyTree = Any
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """(B, ...) -> (mb, B/mb, ...), keeping the per-microbatch batch dim
+    sharded over DP (reference microbatching: ``NxDPPModel`` slices the
+    dataloader batch, model.py:1117-1188)."""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    xm = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    spec = P(None, DP_AXES, *([None] * (xm.ndim - 2)))
+    return jax.lax.with_sharding_constraint(
+        xm, jax.sharding.NamedSharding(ps.get_mesh(), spec)
+    )
+
+
+def pipeline(
+    stage_fn: Callable[..., jax.Array],
+    num_stages: int,
+    num_microbatches: int,
+    remat: bool = True,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable[..., jax.Array]:
+    """Build ``pipelined(stacked_params, x_mb, *broadcast_args) -> y_mb``.
+
+    * ``stacked_params``: pytree whose leaves have leading dim ``L`` (total
+      layers), annotated/sharded ``P("pp", ...)`` — each stage sees its
+      ``L/pp`` slice.
+    * ``x_mb``: ``(mb, b, ...)`` microbatched input (replicated over pp).
+    * ``stage_fn(local_params, x, *broadcast) -> y``: consumes the local
+      ``(L/pp, ...)`` params (typically via an inner ``lax.scan``), returns an
+      activation with the same shape as ``x``.
+    * returns ``(mb, b, ...)`` outputs of the LAST stage, replicated over pp.
+    """
+    mesh = mesh or ps.get_mesh()
+
+    step = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def inner(stacked_params, x_mb, *broadcast_args):
+        rank = lax.axis_index(PP_AXIS)
+        ticks = num_microbatches + num_stages - 1
+        buf0 = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            buf, out_buf = carry
+            feed_idx = jnp.clip(t, 0, num_microbatches - 1)
+            fresh = lax.dynamic_index_in_dim(x_mb, feed_idx, axis=0, keepdims=False)
+            x_in = jnp.where(rank == 0, fresh, buf)
+            y = step(stacked_params, x_in, *broadcast_args)
+            # last stage records microbatch t-(S-1); earlier (bubble) ticks
+            # write garbage into slot 0 which the t = S-1 tick overwrites
+            out_idx = jnp.clip(t - (num_stages - 1), 0, num_microbatches - 1)
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, y, out_idx, axis=0)
+            # rotate activations to the next stage (real p2p over ICI; the
+            # reference emulated this with 2-rank all-gathers, comm.py:38-92)
+            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+            buf_next = lax.ppermute(y, PP_AXIS, perm)
+            return (buf_next, out_buf), None
+
+        (_, out_buf), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+        # replicate the last stage's outputs across pp (masked psum) so the
+        # head/loss downstream runs under plain GSPMD. fp32 for the psum:
+        # XLA:CPU's AllReducePromotion pass crashes on bf16 all-reduce, and
+        # on TPU fp32 reduction costs nothing extra here (one activation).
+        mask = (rank == num_stages - 1).astype(jnp.float32)
+        reduced = lax.psum(out_buf.astype(jnp.float32) * mask, PP_AXIS)
+        return reduced.astype(out_buf.dtype)
+
+    param_specs = lambda tree: jax.tree.map(lambda _: P(PP_AXIS), tree)  # noqa: E731
+
+    def apply(stacked_params, x_mb, *broadcast_args):
+        # pp-replicated float inputs cross the shard_map boundary in fp32:
+        # their cotangents are psum'd over pp by the shard_map transpose, and
+        # XLA:CPU's AllReducePromotion crashes on bf16 all-reduce. Cast back
+        # to the compute dtype inside (free on TPU, fused into first use).
+        dtypes = [x_mb.dtype] + [getattr(a, "dtype", None) for a in broadcast_args]
+
+        def widen(a):
+            return a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype == jnp.bfloat16 else a
+
+        def boundary_inner(stacked_params, x_mb32, *bargs32):
+            x = x_mb32.astype(dtypes[0])
+            bargs = tuple(
+                a.astype(d) if d is not None else a for a, d in zip(bargs32, dtypes[1:])
+            )
+            return inner(stacked_params, x, *bargs)
+
+        return jax.shard_map(
+            boundary_inner,
+            mesh=mesh,
+            in_specs=(param_specs(stacked_params), P(), *([P()] * len(broadcast_args))),
+            out_specs=P(),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )(stacked_params, widen(x_mb), *[widen(a) for a in broadcast_args])
+
+    return apply

@@ -1,0 +1,175 @@
+"""Pipeline schedules as pure-logic task generators.
+
+Capability-parity with the reference's ``pipeline/scheduler.py`` (task classes
+:4-70, ``PipeSchedule``:73, ``InferenceSchedule``:144, ``Train1F1BSchedule``
+:157, ``TrainInterleavedSchedule``:256). The reference's design — schedules as
+generators of ``__eq__``-able task objects, unit-testable with zero devices —
+is kept (SURVEY §4.1 calls it "worth copying" as a *design*), re-expressed
+with frozen dataclasses.
+
+Role on TPU: the SPMD engine (``pipeline/engine.py``) compiles the whole
+1F1B-equivalent dataflow into one XLA program, so these schedules are not
+executed step-by-step by a Python runtime on the hot path. They exist to
+(a) document and test ordering invariants, (b) drive the host-side
+orchestration of multi-program pipelines (inference serving), and (c) give
+users the same introspection surface the reference exposes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    microbatch: int
+    chunk: int = 0  # model-chunk index for interleaved (VPP) schedules
+
+
+@dataclasses.dataclass(frozen=True)
+class ForwardStep(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardStep(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvForward(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendForward(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvBackward(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendBackward(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceGrads(Task):
+    pass
+
+
+def inference_schedule(pp_rank: int, pp_size: int, num_microbatches: int) -> Iterator[List[Task]]:
+    """Forward-only (reference ``InferenceSchedule``, scheduler.py:144)."""
+    for mb in range(num_microbatches):
+        step: List[Task] = []
+        if pp_rank > 0:
+            step.append(RecvForward(mb))
+        step.append(ForwardStep(mb))
+        if pp_rank < pp_size - 1:
+            step.append(SendForward(mb))
+        yield step
+
+
+def train_1f1b_schedule(pp_rank: int, pp_size: int, num_microbatches: int) -> Iterator[List[Task]]:
+    """1F1B: warmup forwards, steady-state alternating fwd/bwd, cooldown
+    backwards (reference ``Train1F1BSchedule``, scheduler.py:157-254).
+
+    Invariants (unit-tested): every rank executes exactly ``num_microbatches``
+    forwards and backwards; in-flight microbatches never exceed
+    ``pp_size - pp_rank``; send/recv sequences of adjacent ranks match.
+    """
+    first, last = pp_rank == 0, pp_rank == pp_size - 1
+    warmup = min(pp_size - pp_rank - 1, num_microbatches)
+    steady = num_microbatches - warmup
+
+    fwd_mb = 0
+    bwd_mb = 0
+
+    # warmup: forwards only
+    for _ in range(warmup):
+        step: List[Task] = []
+        if not first:
+            step.append(RecvForward(fwd_mb))
+        step.append(ForwardStep(fwd_mb))
+        if not last:
+            step.append(SendForward(fwd_mb))
+        fwd_mb += 1
+        yield step
+
+    # steady state: 1 forward + 1 backward per step
+    for i in range(steady):
+        step = []
+        if not first:
+            step.append(RecvForward(fwd_mb))
+        step.append(ForwardStep(fwd_mb))
+        if not last:
+            step.append(SendForward(fwd_mb))
+            step.append(RecvBackward(bwd_mb))
+        step.append(BackwardStep(bwd_mb))
+        if not first:
+            step.append(SendBackward(bwd_mb))
+        fwd_mb += 1
+        bwd_mb += 1
+        yield step
+
+    # cooldown: drain remaining backwards
+    while bwd_mb < num_microbatches:
+        step = []
+        if not last:
+            step.append(RecvBackward(bwd_mb))
+        step.append(BackwardStep(bwd_mb))
+        if not first:
+            step.append(SendBackward(bwd_mb))
+        bwd_mb += 1
+        yield step
+
+    yield [ReduceGrads(0)]
+
+
+def interleaved_schedule(
+    pp_rank: int, pp_size: int, num_microbatches: int, num_chunks: int
+) -> Iterator[List[Task]]:
+    """Interleaved / virtual-pipeline schedule (reference
+    ``TrainInterleavedSchedule``, scheduler.py:256-541): each rank owns
+    ``num_chunks`` model chunks; forwards sweep chunks in blocks of
+    ``pp_size`` microbatches, backwards in reverse chunk order.
+
+    This generator emits the *logical* fwd/bwd order (chunk-major blocks);
+    send/recv pairing is derivable from (microbatch, chunk) adjacency.
+    """
+    if num_microbatches % pp_size != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches ({num_microbatches}) "
+            f"divisible by pp_size ({pp_size})"
+        )
+    total_f = num_microbatches * num_chunks
+    # canonical megatron ordering of (chunk, microbatch) forward units
+    fwd_order = [
+        (chunk, blk * pp_size + m)
+        for blk in range(num_microbatches // pp_size)
+        for chunk in range(num_chunks)
+        for m in range(pp_size)
+    ]
+    bwd_order = [(num_chunks - 1 - c, m) for (c, m) in fwd_order]
+    warmup = min((pp_size - pp_rank - 1) * 2 + (num_chunks - 1) * pp_size, total_f)
+
+    fi = bi = 0
+    for _ in range(warmup):
+        c, m = fwd_order[fi]
+        fi += 1
+        yield [ForwardStep(m, chunk=c)]
+    while fi < total_f:
+        c, m = fwd_order[fi]
+        fi += 1
+        cb, mb = bwd_order[bi]
+        bi += 1
+        yield [ForwardStep(m, chunk=c), BackwardStep(mb, chunk=cb)]
+    while bi < total_f:
+        cb, mb = bwd_order[bi]
+        bi += 1
+        yield [BackwardStep(mb, chunk=cb)]
+    yield [ReduceGrads(0)]

@@ -47,11 +47,9 @@ class ParallelModel:
         return self.module.apply({"params": params}, *args, **kwargs)
 
     def param_shardings(self) -> PyTree:
-        return jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s if isinstance(s, P) else P()),
-            self.param_specs,
-            is_leaf=lambda x: isinstance(x, P) or x is None,
-        )
+        from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
+
+        return specs_to_shardings(self.param_specs, self.mesh)
 
     def num_params(self) -> int:
         return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
@@ -84,12 +82,10 @@ def initialize_parallel_model(
 
     # Abstract-eval once to learn shapes + partition metadata without FLOPs.
     abstract = jax.eval_shape(lambda: module.init(rngs, *example_args, **example_kwargs))
+    from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
+
     specs = nn.get_partition_spec(abstract)["params"]
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
-        specs,
-        is_leaf=lambda x: isinstance(x, P) or x is None,
-    )
+    shardings = specs_to_shardings(specs, mesh)
 
     def init_fn():
         variables = module.init(rngs, *example_args, **example_kwargs)

@@ -1,0 +1,15 @@
+"""Checkpoint subsystem (reference ``trainer/checkpoint.py`` +
+``checkpoint_storage.py`` + ``parallel_layers/checkpointing.py``; SURVEY §5.4)."""
+
+from neuronx_distributed_tpu.checkpoint.core import (  # noqa: F401
+    finalize_checkpoint,
+    has_checkpoint,
+    latest_tag,
+    load_checkpoint,
+    save_checkpoint,
+)
+from neuronx_distributed_tpu.checkpoint.storage import (  # noqa: F401
+    BaseCheckpointStorage,
+    FilesysCheckpointStorage,
+    create_checkpoint_storage,
+)

@@ -1,0 +1,100 @@
+"""Checkpoint storage abstraction (reference ``trainer/checkpoint_storage.py``
+— ``BaseCheckpointStorage``:28, ``FilesysCheckpointStorage``:120,
+``S3CheckpointStorage``:219, factory ``create_checkpoint_storage``:558).
+
+The tensor payload is written by orbax/tensorstore (which has its own gcs/s3
+drivers); this abstraction covers the *control plane* the reference keeps on
+storage: tag directories, marker files, listing, retention deletes.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+
+class BaseCheckpointStorage:
+    def __init__(self, dirname: str):
+        self.dirname = dirname
+
+    # --- control-plane ops used by the checkpoint core ---
+    def dir_exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def file_exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def save_text(self, text: str, path: str) -> None:
+        raise NotImplementedError
+
+    def load_text(self, path: str) -> str:
+        raise NotImplementedError
+
+    def list_dirs(self, path: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def remove_dir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def remove_file(self, path: str) -> None:
+        raise NotImplementedError
+
+    def makedirs(self, path: str = "") -> None:
+        raise NotImplementedError
+
+    def abspath(self, path: str = "") -> str:
+        return os.path.join(self.dirname, path) if path else self.dirname
+
+
+class FilesysCheckpointStorage(BaseCheckpointStorage):
+    """Local / NFS / FUSE-mounted filesystem storage (reference :120)."""
+
+    def dir_exists(self, path: str) -> bool:
+        return os.path.isdir(self.abspath(path))
+
+    def file_exists(self, path: str) -> bool:
+        return os.path.isfile(self.abspath(path))
+
+    def save_text(self, text: str, path: str) -> None:
+        p = self.abspath(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, p)  # atomic marker write
+
+    def load_text(self, path: str) -> str:
+        with open(self.abspath(path)) as f:
+            return f.read()
+
+    def list_dirs(self, path: str = "") -> List[str]:
+        p = self.abspath(path)
+        if not os.path.isdir(p):
+            return []
+        return sorted(d for d in os.listdir(p) if os.path.isdir(os.path.join(p, d)))
+
+    def remove_dir(self, path: str) -> None:
+        shutil.rmtree(self.abspath(path), ignore_errors=True)
+
+    def remove_file(self, path: str) -> None:
+        try:
+            os.remove(self.abspath(path))
+        except FileNotFoundError:
+            pass
+
+    def makedirs(self, path: str = "") -> None:
+        os.makedirs(self.abspath(path), exist_ok=True)
+
+
+def create_checkpoint_storage(dirname: str) -> BaseCheckpointStorage:
+    """Factory (reference :558). Object-store URLs (s3://, gs://) delegate the
+    tensor payload to tensorstore drivers; the control plane currently
+    requires a filesystem view (mount or local cache)."""
+    if dirname.startswith(("s3://", "gs://")):
+        raise NotImplementedError(
+            "object-store control plane not wired yet: mount the bucket "
+            "(gcsfuse / mountpoint-s3) and pass the mount path; tensor IO "
+            "already rides tensorstore"
+        )
+    return FilesysCheckpointStorage(dirname)

@@ -74,6 +74,12 @@ def pipeline(
     * returns ``(mb, b, ...)`` outputs of the LAST stage, replicated over pp.
     """
     mesh = mesh or ps.get_mesh()
+    pp_size = dict(zip(mesh.axis_names, mesh.devices.shape))[PP_AXIS]
+    if num_stages != pp_size:
+        raise ValueError(
+            f"num_stages ({num_stages}) must equal the mesh's pp axis size "
+            f"({pp_size}): a partial ppermute ring would silently zero-fill"
+        )
 
     step = jax.checkpoint(stage_fn) if remat else stage_fn
 

@@ -1,0 +1,136 @@
+"""Checkpoint tests: round-trip with sharded state, marker protocol,
+retention, async save, corrupted-tag cleanup, reshard-on-load (reference
+test/unit_test/checkpoint methodology + §5.4 protocol)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import neuronx_distributed_tpu.checkpoint as ckpt
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+def _state(mesh=None):
+    a = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.ones((4,), jnp.float32)
+    if mesh is not None:
+        a = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
+    return {"w": a, "b": b, "step": jnp.asarray(3)}
+
+
+def test_round_trip_and_markers(tmp_path):
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    state = _state(st.mesh)
+    d = str(tmp_path)
+    assert not ckpt.has_checkpoint(d)
+    ckpt.save_checkpoint(d, "step_10", state, user_content={"step": 10})
+    assert ckpt.has_checkpoint(d)
+    assert os.path.isfile(os.path.join(d, "step_10", "done"))
+    assert os.path.isfile(os.path.join(d, "step_10", "checkpoint"))
+    loaded, uc = ckpt.load_checkpoint(d)
+    assert uc == {"step": 10}
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), np.asarray(state["w"]))
+    np.testing.assert_array_equal(np.asarray(loaded["step"]), 3)
+
+
+def test_latest_and_retention(tmp_path):
+    d = str(tmp_path)
+    for i in range(4):
+        ckpt.save_checkpoint(d, f"step_{i}", {"x": jnp.asarray(i)}, num_kept=2)
+    assert ckpt.latest_tag(d) == "step_3"
+    tags = sorted(t for t in os.listdir(d) if os.path.isdir(os.path.join(d, t)))
+    assert tags == ["step_2", "step_3"], tags
+    loaded, _ = ckpt.load_checkpoint(d)
+    assert int(loaded["x"]) == 3
+
+
+def test_async_save_donation_safe(tmp_path):
+    d = str(tmp_path)
+    x = jnp.arange(16.0)
+    ckpt.save_checkpoint(d, "t0", {"x": x}, async_save=True)
+    # mutate nothing; just ensure finalize completes and data is correct
+    ckpt.finalize_checkpoint()
+    loaded, _ = ckpt.load_checkpoint(d, "t0")
+    np.testing.assert_array_equal(np.asarray(loaded["x"]), np.arange(16.0))
+
+
+def test_interrupted_save_cleanup(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, "good", {"x": jnp.asarray(1)})
+    # simulate an interrupted save: marker without done
+    os.makedirs(os.path.join(d, "broken"))
+    open(os.path.join(d, "broken", "checkpoint"), "w").close()
+    assert ckpt.latest_tag(d) == "good"
+    ckpt.save_checkpoint(d, "good2", {"x": jnp.asarray(2)})
+    assert not os.path.isdir(os.path.join(d, "broken"))
+    assert ckpt.latest_tag(d) == "good2"
+
+
+def test_reshard_on_load(tmp_path):
+    """Save with tp=4 sharding, load into tp=2-style sharding (the resharding
+    converters' common case, reference optimizer/zero_dcp_utils.py)."""
+    d = str(tmp_path)
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    state = _state(st.mesh)
+    ckpt.save_checkpoint(d, "t", state)
+    ps.destroy_model_parallel()
+
+    st2 = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    target = {
+        "w": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                  sharding=NamedSharding(st2.mesh, P(None, "tp"))),
+        "b": jax.ShapeDtypeStruct((4,), jnp.float32,
+                                  sharding=NamedSharding(st2.mesh, P())),
+        "step": jax.ShapeDtypeStruct((), jnp.int32,
+                                     sharding=NamedSharding(st2.mesh, P())),
+    }
+    loaded, _ = ckpt.load_checkpoint(d, "t", target=target)
+    assert loaded["w"].sharding.spec == P(None, "tp")
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+def test_train_state_resume(tmp_path):
+    """Full resume: save mid-training, reload into the sharded TrainState,
+    continue — losses must continue the same trajectory."""
+    import optax
+    from flax import linen as nn
+    from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear, RowParallelLinear
+    from neuronx_distributed_tpu.trainer import (
+        create_train_state, initialize_parallel_model,
+        initialize_parallel_optimizer, make_train_step, neuronx_distributed_config,
+    )
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return RowParallelLinear(16, name="d")(nn.gelu(ColumnParallelLinear(32, name="u")(x)))
+
+    d = str(tmp_path)
+    cfg = neuronx_distributed_config(tensor_parallel_size=2)
+    x = np.random.RandomState(0).randn(8, 4, 16).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 4, 16).astype(np.float32)
+    model = initialize_parallel_model(cfg, MLP, jnp.zeros((8, 4, 16)))
+    opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-2, weight_decay=0.0)
+    state = create_train_state(model, opt)
+    step = make_train_step(model, opt, lambda p, b, r: jnp.mean((model.apply(p, b["x"]) - b["y"]) ** 2),
+                           donate=False)
+    batch = {"x": x, "y": y}
+    for i in range(2):
+        state, _ = step(state, batch, jax.random.key(i))
+    ckpt.save_checkpoint(d, "mid", state, user_content={"step": 2})
+    state3, m3 = step(state, batch, jax.random.key(2))
+    expected = float(m3["loss"])
+
+    restored, uc = ckpt.load_checkpoint(d, "mid", target=state)
+    assert uc["step"] == 2
+    # restored is a dict matching TrainState fields; rebuild the struct
+    from neuronx_distributed_tpu.trainer.step import TrainState
+    if not isinstance(restored, TrainState):
+        restored = jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(state), jax.tree.leaves(restored))
+    _, m = step(restored, batch, jax.random.key(2))
+    np.testing.assert_allclose(float(m["loss"]), expected, rtol=1e-6)

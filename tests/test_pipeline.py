@@ -119,11 +119,9 @@ def test_pp_matches_dense_forward():
     golden = dense_apply(params, ids)
 
     st = ps.initialize_model_parallel(pipeline_model_parallel_size=4)
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
     specs = pm.param_specs(ids)
-    sharded = jax.device_put(params, jax.tree.map(
-        lambda s: NamedSharding(st.mesh, s if isinstance(s, P) else P()),
-        specs, is_leaf=lambda x: isinstance(x, P) or x is None))
+    sharded = jax.device_put(params, specs_to_shardings(specs, st.mesh))
     with jax.set_mesh(st.mesh):
         out = jax.jit(pm.apply)(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-4, atol=2e-4)

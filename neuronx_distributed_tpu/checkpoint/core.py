@@ -122,8 +122,15 @@ def save_checkpoint(
     # device buffers the moment we return). Multi-host arrays that span
     # non-addressable devices stay as jax.Arrays — orbax/tensorstore writes
     # each host's addressable shards (no full gather is possible there).
+    has_remote = False
+
     def snap(x):
+        nonlocal has_remote
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # cannot host-gather a multi-host array; the write must happen
+            # BEFORE the caller's next (donating) step, so async degrades to
+            # sync below
+            has_remote = True
             return x
         return np.asarray(x)
 
@@ -172,12 +179,19 @@ def save_checkpoint(
                 storage.remove_file(f"{old}/{_DONE_MARKER}")
                 storage.remove_dir(old)
 
-    if async_save:
-        fut = _get_executor().submit(write)
-        with _lock:
-            _pending.append(fut)
-    else:
-        write()
+    if has_remote and async_save:
+        logger.warning(
+            "async_save downgraded to sync: state contains multi-host arrays "
+            "whose device buffers cannot be host-snapshotted (donation safety)"
+        )
+        async_save = False
+    # BOTH paths go through the 1-worker executor so cleanup/markers/retention
+    # are serialized against any pending async save; sync just blocks on it
+    fut = _get_executor().submit(write)
+    with _lock:
+        _pending.append(fut)
+    if not async_save:
+        fut.result()
 
 
 def load_checkpoint(

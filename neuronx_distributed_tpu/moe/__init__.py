@@ -1,0 +1,12 @@
+"""Mixture-of-Experts + expert parallelism (reference ``modules/moe/``;
+SURVEY §2.2 MoE rows + §2.3 EP). GShard-style dispatch algebra under GSPMD;
+expert weights (E,H,I) sharded (ep, None, tp)."""
+
+from neuronx_distributed_tpu.moe.layer import MoE, collect_aux_losses  # noqa: F401
+from neuronx_distributed_tpu.moe.expert_mlps import ExpertMLPs  # noqa: F401
+from neuronx_distributed_tpu.moe.routing import (  # noqa: F401
+    RouterSinkhorn,
+    RouterTopK,
+    load_balancing_loss,
+    router_z_loss,
+)

@@ -1,0 +1,119 @@
+"""Expert MLP execution (reference ``modules/moe/expert_mlps.py`` —
+``forward_all_experts``:139, ``forward_capacity_factor``:169, mode dispatch
+``forward``:297 — and ``modules/moe/experts.py`` fused gate/up/down +
+``moe_parallel_layers.py`` 3D-weight einsum linears).
+
+TPU-native re-design (GShard/Switch dispatch algebra under GSPMD):
+
+* Expert weights are 3D ``(E, H, I)`` with spec ``(ep, None, tp)`` — E over
+  the expert-parallel mesh axis, I over TP. The reference's
+  ``ExpertFusedColumnParallelLinear`` machinery becomes these annotations.
+* **capacity_factor mode**: token positions inside each expert come from an
+  int32 cumsum over the top-k mask — EXACT integer arithmetic, replacing the
+  reference's fp64 matmul-tril cumsum (``utils/tensor_utils.py:4``,
+  fp64 absent on TPU — SURVEY §7.3 hard part 4). Dispatch/combine are
+  one-hot einsums; XLA lowers the token->expert resharding to the EP
+  all-to-all the reference issues by hand (``mappings.py:311-338``).
+* **all_experts mode**: every expert computes every token, outputs weighted
+  by the combine matrix — no dropping, O(E) FLOPs, for small E or goldens.
+* **selective loading** (per-token expert gather for token-gen inference)
+  arrives with the inference stack.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.layers import default_kernel_init
+from neuronx_distributed_tpu.parallel.mesh import EP_AXIS, TP_AXIS
+from neuronx_distributed_tpu.parallel.partitioning import constrain
+
+
+class ExpertMLPs(nn.Module):
+    """E parallel gated MLPs with fused 3D weights."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    glu: bool = True
+    capacity_factor: float = 1.25
+    mode: str = "capacity_factor"  # "capacity_factor" | "all_experts"
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        E, H, I = self.num_experts, self.hidden_size, self.intermediate_size
+        init = default_kernel_init
+        self.w_gate = self.param(
+            "gate", nn.with_partitioning(init, (EP_AXIS, None, TP_AXIS)), (E, H, I),
+            self.param_dtype)
+        if self.glu:
+            self.w_up = self.param(
+                "up", nn.with_partitioning(init, (EP_AXIS, None, TP_AXIS)), (E, H, I),
+                self.param_dtype)
+        self.w_down = self.param(
+            "down", nn.with_partitioning(init, (EP_AXIS, TP_AXIS, None)), (E, I, H),
+            self.param_dtype)
+
+    def _mlp(self, h: jax.Array) -> jax.Array:
+        """h: (E, C, H) expert-major activations, E sharded over ep."""
+        h = h.astype(self.dtype)
+        wg = self.w_gate.astype(self.dtype)
+        wd = self.w_down.astype(self.dtype)
+        g = jnp.einsum("ech,ehi->eci", h, wg)
+        g = constrain(g, P(EP_AXIS, None, TP_AXIS))
+        if self.glu:
+            u = jnp.einsum("ech,ehi->eci", h, self.w_up.astype(self.dtype))
+            a = nn.silu(g) * u
+        else:
+            a = nn.gelu(g)
+        out = jnp.einsum("eci,eih->ech", a, wd)
+        return constrain(out, P(EP_AXIS, None, None))
+
+    # --- capacity-factor (static shapes, token dropping) -----------------
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(self.capacity_factor * num_tokens / self.num_experts)
+        return max(1, min(c, num_tokens))
+
+    def forward_capacity_factor(self, x: jax.Array, combine: jax.Array) -> jax.Array:
+        """x: (T, H) tokens; combine: (T, E) router weights (k nonzero/row).
+        Returns (T, H). Tokens beyond an expert's capacity are DROPPED in
+        priority order of token index (reference forward_capacity_factor
+        semantics, expert_mlps.py:169-266)."""
+        T, H = x.shape
+        E = self.num_experts
+        C = self.capacity(T)
+        mask = (combine > 0).astype(jnp.int32)                    # (T, E)
+        # EXACT int32 position-in-expert (reference needed fp64 matmul cumsum)
+        pos = jnp.cumsum(mask, axis=0) * mask - mask              # 0-based, (T, E)
+        keep = (pos < C) & (mask > 0)
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=x.dtype)  # (T, E, C); C==drop
+        dispatch = pos_oh * keep[..., None].astype(x.dtype)       # (T, E, C)
+        combine_w = dispatch * combine[..., None].astype(x.dtype)  # (T, E, C)
+
+        expert_in = jnp.einsum("th,tec->ech", x, dispatch)
+        expert_in = constrain(expert_in, P(EP_AXIS, None, None))   # EP all-to-all here
+        expert_out = self._mlp(expert_in)
+        out = jnp.einsum("ech,tec->th", expert_out, combine_w)
+        return out.astype(x.dtype)
+
+    # --- all-experts (dense, no dropping) --------------------------------
+
+    def forward_all_experts(self, x: jax.Array, combine: jax.Array) -> jax.Array:
+        """Every expert runs every token (reference forward_all_experts,
+        expert_mlps.py:139-167)."""
+        T, H = x.shape
+        h = jnp.broadcast_to(x[None], (self.num_experts, T, H))
+        out = self._mlp(h)                                         # (E, T, H)
+        return jnp.einsum("eth,te->th", out, combine.astype(out.dtype)).astype(x.dtype)
+
+    def __call__(self, x: jax.Array, combine: jax.Array) -> jax.Array:
+        if self.mode == "capacity_factor":
+            return self.forward_capacity_factor(x, combine)
+        if self.mode == "all_experts":
+            return self.forward_all_experts(x, combine)
+        raise ValueError(f"unknown expert mode {self.mode!r}")

@@ -1,0 +1,90 @@
+"""MoE routers (reference ``modules/moe/routing.py`` — ``RouterBase``:9,
+``RouterTopK``:89, ``RouterSinkhorn``:123, fixed-iteration ``_sinkhorn``:186).
+
+Routing math runs in fp32 (the reference leans on fp64 via XLA_DOWNCAST
+tricks for its mask arithmetic — SURVEY §7.3; here all integer bookkeeping is
+int32, which is exact, and only probabilities are float)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.parallel.layers import default_kernel_init
+
+
+class RouterTopK(nn.Module):
+    """Softmax top-k router. Returns (combine_weights, logits) where
+    ``combine_weights`` is (T, E) with exactly ``top_k`` nonzeros per row,
+    renormalized to sum 1 (reference RouterTopK, routing.py:89-121)."""
+
+    num_experts: int
+    top_k: int = 2
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        # router weight is replicated (the reference's LinearRouter with
+        # weight-grad all-reduce, moe_parallel_layers.py:348)
+        w = self.param("kernel", default_kernel_init, (x.shape[-1], self.num_experts),
+                       self.param_dtype)
+        logits = (x.astype(jnp.float32) @ w.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, self.top_k)
+        mask = jnp.sum(jax.nn.one_hot(topi, self.num_experts, dtype=probs.dtype), axis=-2)
+        gates = probs * mask
+        denom = jnp.sum(gates, axis=-1, keepdims=True)
+        combine = gates / jnp.maximum(denom, 1e-9)
+        return combine, logits
+
+
+class RouterSinkhorn(nn.Module):
+    """Top-1 Sinkhorn-balanced router with a FIXED iteration count so the
+    graph stays static (reference RouterSinkhorn, routing.py:123-218)."""
+
+    num_experts: int
+    num_iterations: int = 3
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        w = self.param("kernel", default_kernel_init, (x.shape[-1], self.num_experts),
+                       self.param_dtype)
+        logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+
+        # sinkhorn balancing on the assignment matrix (training-time only;
+        # gradients flow through the softmax gate, not the balancing)
+        cost = jax.lax.stop_gradient(logits)
+        # max-subtract before exp (overflow-safe; invariant under the
+        # row/column normalizations below)
+        pi = jnp.exp(cost - jnp.max(cost, axis=-1, keepdims=True))
+        for _ in range(self.num_iterations):
+            pi = pi / jnp.maximum(jnp.sum(pi, axis=0, keepdims=True), 1e-9)  # col balance
+            pi = pi / jnp.maximum(jnp.sum(pi, axis=1, keepdims=True), 1e-9)  # row norm
+        top1 = jnp.argmax(pi, axis=-1)
+        mask = jax.nn.one_hot(top1, self.num_experts, dtype=jnp.float32)
+        gate = jnp.sum(jax.nn.softmax(logits, axis=-1) * mask, axis=-1, keepdims=True)
+        return mask * gate, logits
+
+
+def load_balancing_loss(logits: jax.Array, combine: jax.Array, num_experts: int) -> jax.Array:
+    """Switch-Transformer aux loss (reference ``moe/loss_function.py:5``):
+    ``E * sum_e f_e * p_e`` with f = fraction of tokens dispatched to e and
+    p = mean router prob for e."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    dispatched = (combine > 0).astype(jnp.float32)
+    f = jnp.mean(dispatched, axis=0)          # (E,)
+    p = jnp.mean(probs, axis=0)               # (E,)
+    return num_experts * jnp.sum(f * p)
+
+
+def router_z_loss(logits: jax.Array) -> jax.Array:
+    """ST-MoE z-loss — stabilizes router logits (extension beyond the
+    reference's loss set; off by default in the MoE layer)."""
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(z**2)

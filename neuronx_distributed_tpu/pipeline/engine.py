@@ -74,7 +74,7 @@ def pipeline(
     * returns ``(mb, b, ...)`` outputs of the LAST stage, replicated over pp.
     """
     mesh = mesh or ps.get_mesh()
-    pp_size = dict(zip(mesh.axis_names, mesh.devices.shape))[PP_AXIS]
+    pp_size = mesh.shape[PP_AXIS]
     if num_stages != pp_size:
         raise ValueError(
             f"num_stages ({num_stages}) must equal the mesh's pp axis size "

@@ -191,7 +191,12 @@ def save_checkpoint(
     with _lock:
         _pending.append(fut)
     if not async_save:
-        fut.result()
+        try:
+            fut.result()
+        finally:
+            with _lock:
+                if fut in _pending:
+                    _pending.remove(fut)
 
 
 def load_checkpoint(

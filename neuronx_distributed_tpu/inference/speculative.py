@@ -1,0 +1,122 @@
+"""Speculative (draft-model assisted) decoding.
+
+Reference: ``utils/speculative_decoding.py`` (``NeuronSpeculation``:15,
+``_standard_assisted_decoding``:40) — a smaller draft model proposes
+``num_draft`` tokens per round; the target model scores the whole chunk in
+ONE cached forward and the longest agreeing prefix is accepted. Greedy
+acceptance (token equality), the reference's standard mode.
+
+Cache rollback is the key mechanic: the chunked verify writes all proposed
+positions into the KV cache; rejected tail positions are "rolled back" by
+resetting the per-slot ``cache_index`` — later writes overwrite the stale
+entries, and the length mask hides them meanwhile (the reference manipulates
+its aliased KV buffers the same way). Medusa-tree decoding (reference
+``utils/medusa_utils.py``) is a planned extension on the same chunk-verify
+primitive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult, _set_cache_index
+
+
+def speculative_generate(
+    target: CausalLM,
+    draft: CausalLM,
+    prompt_ids: np.ndarray,
+    max_new_tokens: int,
+    num_draft: int = 4,
+) -> GenerationResult:
+    """Greedy assisted decoding. ``target``/``draft`` must be compiled (or
+    compilable) CausalLMs with identical tokenizers; batch size 1 per call
+    (the reference's assisted loop is also per-sequence)."""
+    if prompt_ids.shape[0] != 1:
+        raise ValueError("speculative_generate handles batch size 1")
+    if target._decode is None:
+        target.compile()
+    if draft._decode is None:
+        draft.compile()
+
+    # chunked verify program on the target: γ+1 tokens at the current index
+    def chunk_fn(params, cache, ids):
+        logits, mut = target.model.apply(
+            {"params": params, "cache": cache}, ids, mutable=["cache"]
+        )
+        return logits, mut["cache"]
+
+    b = target.max_batch
+    s = prompt_ids.shape[1]
+    length0 = int((prompt_ids[0] != 0).sum())
+    if length0 + max_new_tokens + num_draft + 1 > target.config.max_seq_len:
+        raise ValueError(
+            f"prompt ({length0}) + max_new_tokens ({max_new_tokens}) + draft window "
+            f"({num_draft + 1}) exceeds max_seq_len {target.config.max_seq_len}"
+        )
+    bucket = target._bucket_for(s)
+    ids = np.zeros((b, bucket), np.int32)
+    ids[0, :s] = prompt_ids[0]
+    length = int((prompt_ids[0] != 0).sum())
+
+    t_logits, t_cache = target._prefill[bucket](target.params, jnp.asarray(ids))
+    d_logits, d_cache = draft._prefill[bucket](draft.params, jnp.asarray(ids))
+    lens = np.zeros((b,), np.int32)
+    lens[0] = length
+    t_cache = _set_cache_index(t_cache, jnp.asarray(lens))
+    d_cache = _set_cache_index(d_cache, jnp.asarray(lens))
+    last_tok = int(np.asarray(jnp.argmax(t_logits[0, length - 1])))
+
+    chunk = jnp.zeros((b, num_draft + 1), jnp.int32)
+    chunk_compiled = jax.jit(chunk_fn, donate_argnums=(1,)).lower(
+        target.params, t_cache, chunk
+    ).compile()
+
+    out: list[int] = [last_tok]
+    cur_len = length
+    while len(out) < max_new_tokens:
+        # draft proposes num_draft tokens by plain decode
+        proposals = []
+        tok = out[-1]
+        for _ in range(num_draft):
+            dl, d_cache = draft._decode(draft.params, d_cache,
+                                        jnp.full((b, 1), tok, jnp.int32))
+            tok = int(np.asarray(jnp.argmax(dl[0, 0])))
+            proposals.append(tok)
+        # target scores [last, p1..pγ] in one chunked forward
+        chunk_np = np.zeros((b, num_draft + 1), np.int32)
+        chunk_np[0] = [out[-1]] + proposals
+        t_logits, t_cache = chunk_compiled(target.params, t_cache,
+                                           jnp.asarray(chunk_np))
+        greedy = np.asarray(jnp.argmax(t_logits[0], axis=-1))     # (γ+1,)
+        accepted = 0
+        while accepted < num_draft and proposals[accepted] == greedy[accepted]:
+            accepted += 1
+        new_tokens = proposals[:accepted] + [int(greedy[accepted])]
+        out.extend(new_tokens)
+        cur_len += len(new_tokens)
+        # Draft cache bookkeeping. The draft loop wrote K/V for its γ inputs
+        # [out_prev, p1..p_{γ-1}] at positions old..old+γ-1. The accepted
+        # sequence needs positions old..old+accepted holding
+        # [out_prev, p1..p_accepted]:
+        # * accepted < γ — everything needed is already written; rolling the
+        #   index back below both invalidates the rejected tail and avoids
+        #   any replay;
+        # * accepted == γ — position old+γ must hold p_γ, which the draft
+        #   never consumed: feed it once (logits discarded) to fill the hole.
+        if accepted == num_draft:
+            _, d_cache = draft._decode(draft.params, d_cache,
+                                       jnp.full((b, 1), proposals[-1], jnp.int32))
+        # roll both caches to the accepted length (stale tail entries are
+        # masked now and overwritten by later writes)
+        lens[0] = cur_len
+        t_cache = _set_cache_index(t_cache, jnp.asarray(lens))
+        d_cache = _set_cache_index(d_cache, jnp.asarray(lens))
+
+    tokens = np.zeros((1, max_new_tokens), np.int64)
+    tokens[0] = out[:max_new_tokens]
+    return GenerationResult(tokens=tokens, lengths=np.asarray([max_new_tokens], np.int32))

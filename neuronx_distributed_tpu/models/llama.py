@@ -61,6 +61,7 @@ class LlamaConfig:
     remat_policy: Optional[str] = "full"  # None | "full" | "attention"
     kv_size_multiplier: int = 1
     tie_word_embeddings: bool = False
+    decode: bool = False  # KV-cache inference mode (cache collection)
 
     @property
     def head_dim_(self) -> int:
@@ -109,11 +110,42 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
+    """Decode/prefill attention against a fixed-size KV cache.
+
+    ``q``: (b, s_new, n, d) — queries at absolute positions
+    ``cache_len .. cache_len+s_new``; ``k_cache``/``v_cache``: (b, S_max,
+    n_kv, d); key j is valid for query i iff ``j <= cache_len + i`` AND the
+    slot has been written. The reference's KV-cache attention with
+    bottom-aligned causal semantics (examples/inference/modules/
+    attention_base.py; SURVEY §2.2 inference examples row)."""
+    b, s_new, n, d = q.shape
+    n_kv = k_cache.shape[2]
+    if n != n_kv:
+        k_cache = jnp.repeat(k_cache, n // n_kv, axis=2)
+        v_cache = jnp.repeat(v_cache, n // n_kv, axis=2)
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    s_max = k_cache.shape[1]
+    cache_len = jnp.asarray(cache_len)
+    if cache_len.ndim == 0:
+        cache_len = jnp.broadcast_to(cache_len, (b,))
+    scores = jnp.einsum("bind,bjnd->bnij", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * sm_scale
+    qpos = cache_len[:, None] + jnp.arange(s_new)[None, :]      # (b, s_new)
+    kpos = jnp.arange(s_max)
+    mask = kpos[None, None, :] <= qpos[..., None]               # (b, s_new, s_max)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnij,bjnd->bind", probs, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, rope: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    def __call__(self, x: jax.Array, rope) -> jax.Array:
         cfg = self.config
         hd = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -126,6 +158,8 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
+        if cfg.decode:
+            return self._decode_attention(x, q, k, v)
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
@@ -138,11 +172,49 @@ class LlamaAttention(nn.Module):
             block_k=cfg.attention_block_k,
         )
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
+        return self._o_proj(o)
+
+    def _o_proj(self, o):
+        cfg = self.config
         return RowParallelLinear(
             cfg.hidden_size, use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="o_proj",
         )(o)
+
+    def _decode_attention(self, x, q, k, v):
+        """KV-cached path (flax ``cache`` collection; the reference keeps KV
+        state in aliased runtime buffers, model_base.py KV management —
+        donation of the cache collection is the TPU analogue)."""
+        cfg = self.config
+        b = x.shape[0]
+        s_new = x.shape[1]
+        n_kv = k.shape[2]
+        hd = cfg.head_dim_
+        ck = self.variable("cache", "cached_key",
+                           jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
+        cv = self.variable("cache", "cached_value",
+                           jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
+        # per-slot lengths: continuous batching reorders/restarts slots
+        # independently (reference model_wrapper.py:207 seq_ids machinery)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((b,), jnp.int32))
+        idx = ci.value                                            # (b,)
+        # unified write: s_new tokens land at positions idx..idx+s_new per
+        # slot — covers prefill (idx=0), single-token decode, and multi-token
+        # speculative verification chunks (reference CTX/TKG/speculation
+        # submodels, model_wrapper.py)
+        positions = idx[:, None] + jnp.arange(s_new, dtype=jnp.int32)[None, :]
+        rows = jnp.arange(b)[:, None]
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        ck.value = ck.value.at[rows, positions].set(k.astype(ck.value.dtype))
+        cv.value = cv.value.at[rows, positions].set(v.astype(cv.value.dtype))
+        ci.value = idx + s_new
+        o = cached_attention(q, ck.value, cv.value, idx)
+        o = o.reshape(b, s_new, -1)
+        return self._o_proj(o)
 
 
 class LlamaMLP(nn.Module):
@@ -226,7 +298,7 @@ class LlamaModel(nn.Module):
         # (unsharded) layer axis
         self.layers = nn.scan(
             _LayerStep,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             length=cfg.num_layers,
             in_axes=nn.broadcast,

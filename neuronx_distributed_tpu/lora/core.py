@@ -1,0 +1,120 @@
+"""LoRA adapters, functional (reference ``modules/lora/`` — ``LoraConfig``
+config.py:6, ``LoraModel``:model.py:75 with inject_adapter:175,
+merge_lora:357, save_lora:467; TP variants tp_layer.py).
+
+The reference swaps nn.Modules for Lora peers. Flax modules are frozen
+pytrees, so the TPU-native formulation is a *parameter transform*: for every
+targeted kernel ``W (in, out)`` create ``A (in, r)``, ``B (r, out)`` and
+train with ``W_eff = W + (alpha/r) * A @ B`` materialized inside the jitted
+step — mathematically identical to the adapter-on-activation form, uniform
+across plain/TP/GQA layers (A/B inherit W's sharding on their preserved
+dims), and trivially mergeable (the merge IS the forward).
+
+Base weights stay frozen by construction: the train step differentiates the
+loss w.r.t. the LoRA tree only, so no optimizer state exists for the base
+(the reference freezes via requires_grad).
+
+Adapter-only checkpoints = ``save_checkpoint(dir, tag, lora_params)``
+(reference save_lora/load_lora).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Reference ``LoraConfig`` (config.py:6) surface."""
+
+    r: int = 8
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0        # applied by the caller's rng discipline
+    target_modules: Tuple[str, ...] = ("qkv", "o_proj", "gate_proj", "up_proj", "down_proj")
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.r
+
+
+def _is_target(path_str: str, cfg: LoraConfig) -> bool:
+    return any(re.search(rf"\b{re.escape(t)}\b|\['{re.escape(t)}'\]", path_str)
+               for t in cfg.target_modules)
+
+
+def _kernel_2d(shape) -> Optional[Tuple[int, int]]:
+    """LoRA factorization dims: 2D kernels as-is; >=3D kernels (GQA (H,N,D),
+    expert (E,H,I)) flatten trailing dims into 'out'."""
+    if len(shape) < 2:
+        return None
+    fan_in = shape[0]
+    fan_out = 1
+    for s in shape[1:]:
+        fan_out *= s
+    return fan_in, fan_out
+
+
+def init_lora(params: PyTree, config: LoraConfig, rng: jax.Array,
+              param_specs: Optional[PyTree] = None) -> PyTree:
+    """Create the adapter tree, mirroring ``params`` structure but containing
+    only targeted kernels, each as {"lora_a": (in, r), "lora_b": (r, out)}.
+    ``lora_b`` starts at zero so W_eff == W at step 0 (reference
+    inject_adapter init)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    adapters = {}
+    keys = jax.random.split(rng, max(len(flat), 1))
+    for (path, leaf), key in zip(flat, keys):
+        pstr = jax.tree_util.keystr(path)
+        dims = _kernel_2d(getattr(leaf, "shape", ()))
+        if dims is None or not _is_target(pstr, config) or not pstr.endswith("ernel']"):
+            continue
+        fan_in, fan_out = dims
+        a = jax.random.normal(key, (fan_in, config.r), jnp.float32) * (1.0 / fan_in**0.5)
+        b = jnp.zeros((config.r, fan_out), jnp.float32)
+        adapters[pstr] = {"lora_a": a, "lora_b": b}
+    if not adapters:
+        raise ValueError(f"no kernels matched target_modules {config.target_modules}")
+    return adapters
+
+
+def merge_lora(params: PyTree, lora_params: PyTree, config: LoraConfig) -> PyTree:
+    """W_eff = W + scaling * A @ B, reshaped back to W's shape (reference
+    ``merge_lora``:357 — here the merge is also the forward path)."""
+
+    def merge_leaf(path, leaf):
+        pstr = jax.tree_util.keystr(path)
+        ad = lora_params.get(pstr)
+        if ad is None:
+            return leaf
+        delta = (ad["lora_a"] @ ad["lora_b"]) * config.scaling
+        return leaf + delta.reshape(leaf.shape).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(merge_leaf, params)
+
+
+def lora_param_specs(lora_params: PyTree, params: PyTree,
+                     param_specs: PyTree) -> PyTree:
+    """Shardings for A/B derived from the base kernel's spec: A keeps the
+    fan-in sharding, B keeps the (flattened) fan-out sharding on its last dim
+    (reference tp_layer.py column/row adapter sharding)."""
+    flat_specs = {
+        jax.tree_util.keystr(p): s
+        for p, s in jax.tree_util.tree_flatten_with_path(
+            param_specs, is_leaf=lambda x: isinstance(x, P) or x is None)[0]
+    }
+    out = {}
+    for pstr, ad in lora_params.items():
+        spec = flat_specs.get(pstr)
+        entries = list(spec) if isinstance(spec, P) else []
+        in_axis = entries[0] if entries else None
+        out_axis = entries[1] if len(entries) > 1 else None
+        out[pstr] = {"lora_a": P(in_axis, None), "lora_b": P(None, out_axis)}
+    return out

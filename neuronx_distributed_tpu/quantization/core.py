@@ -1,0 +1,93 @@
+"""Weight-only int8 quantization, functional (reference ``quantization/`` —
+``QuantizationType`` quantization_config.py:19, ``convert`` quantize.py:13,
+``scale_dequantize``/``direct_cast_dequantize`` dequantize.py, observer.py
+``PerChannelAbsMaxObserver``:12, quantized TP layers
+quantization_layers.py:342,507,668).
+
+The reference swaps float modules for quantized peers that dequantize before
+the matmul. Functionally on TPU: ``quantize_params`` turns targeted kernels
+into ``{"qweight": int8, "scale": fp32}`` leaves; ``dequantize_params``
+restores a float tree INSIDE jit, so int8 weights are what lives in HBM and
+XLA fuses the dequant multiply into the consuming matmul — the same
+dequant-then-matmul compute strategy, without a parallel class hierarchy.
+Sharding survives: qweight keeps the kernel's PartitionSpec (int8 shards like
+the float weight did); per-channel scales shard with the output dim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationConfig:
+    """Reference ``QuantizationConfig`` surface (quantization_config.py)."""
+
+    quantization_type: str = "per_channel_symmetric"  # | "per_tensor_symmetric"
+    quantized_dtype: Any = jnp.int8
+    target_patterns: Tuple[str, ...] = ("kernel",)    # leaf-name match
+    exclude_patterns: Tuple[str, ...] = ("embed", "lm_head", "norm", "bias")
+
+
+def _is_target(pstr: str, cfg: QuantizationConfig) -> bool:
+    if any(re.search(pat, pstr) for pat in cfg.exclude_patterns):
+        return False
+    return any(re.search(pat, pstr) for pat in cfg.target_patterns)
+
+
+class QuantizedLeaf(dict):
+    """Marker dict {'qweight', 'scale'} so trees round-trip through pytrees."""
+
+
+def quantize_params(params: PyTree, config: Optional[QuantizationConfig] = None) -> PyTree:
+    """Abs-max symmetric int8 quantization of targeted kernels (reference
+    observer.py PerTensor/PerChannelAbsMaxObserver + quantize.py convert)."""
+    config = config or QuantizationConfig()
+    info = jnp.iinfo(config.quantized_dtype)
+
+    def q(path, leaf):
+        pstr = jax.tree_util.keystr(path)
+        if getattr(leaf, "ndim", 0) < 2 or not _is_target(pstr, config):
+            return leaf
+        w = jnp.asarray(leaf, jnp.float32)
+        if config.quantization_type == "per_channel_symmetric":
+            # scale per output channel (last dim), reference observer.py:12
+            absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+        elif config.quantization_type == "per_tensor_symmetric":
+            absmax = jnp.max(jnp.abs(w))
+        else:
+            raise ValueError(f"unknown quantization_type {config.quantization_type!r}")
+        scale = jnp.maximum(absmax / info.max, 1e-12)
+        qw = jnp.clip(jnp.round(w / scale), info.min, info.max).astype(config.quantized_dtype)
+        return QuantizedLeaf(qweight=qw, scale=scale.astype(jnp.float32))
+
+    return jax.tree_util.tree_map_with_path(
+        q, params, is_leaf=lambda x: isinstance(x, QuantizedLeaf) or not isinstance(x, dict)
+    )
+
+
+def dequantize_params(qparams: PyTree, dtype=jnp.bfloat16) -> PyTree:
+    """Scale-dequantize inside jit (reference ``scale_dequantize``,
+    dequantize.py:17): qweight * scale, cast to compute dtype."""
+
+    def dq(x):
+        if isinstance(x, dict) and "qweight" in x:
+            return (x["qweight"].astype(jnp.float32) * x["scale"]).astype(dtype)
+        return x
+
+    return jax.tree.map(
+        dq, qparams, is_leaf=lambda x: isinstance(x, dict) and "qweight" in x
+    )
+
+
+def quantized_apply(module, qparams: PyTree, *args, dtype=jnp.bfloat16, **kwargs):
+    """Run a flax module from quantized params — the dequant happens under
+    the caller's jit so XLA fuses it into the consuming matmuls."""
+    return module.apply({"params": dequantize_params(qparams, dtype)}, *args, **kwargs)

@@ -66,12 +66,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[...].astype(jnp.float32)          # (block_q, d)
-        k = k_ref[...].astype(jnp.float32)          # (block_k, d)
-        v = v_ref[...].astype(jnp.float32)          # (block_k, d)
+        # operands stay in their storage dtype (bf16 on TPU) so the MXU runs
+        # at bf16 rate; accumulation is fp32 via preferred_element_type
+        q = q_ref[...]                              # (block_q, d)
+        k = k_ref[...]                              # (block_k, d)
+        v = v_ref[...]                              # (block_k, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale                               # (block_q, block_k)
+        ) * sm_scale                               # (block_q, block_k) fp32
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -82,7 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_scr[:] = m_new
         l_scr[:] = l_new
@@ -121,10 +123,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        # bf16 operands on the MXU, fp32 accumulation (see fwd kernel note)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
         lse = lse_ref[...][:, :1]
         delta = delta_ref[...][:, :1]
         s = jax.lax.dot_general(
@@ -134,16 +137,18 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                      # (bq, bk)
+        p = jnp.exp(s - lse)                      # (bq, bk) fp32
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when((qi == q_blocks - 1) & (g == group - 1))
@@ -166,10 +171,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        # bf16 operands on the MXU, fp32 accumulation (see fwd kernel note)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
         lse = lse_ref[...][:, :1]
         delta = delta_ref[...][:, :1]
         s = jax.lax.dot_general(
@@ -184,7 +190,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
-        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] += jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
 
     @pl.when(ki == kv_blocks - 1)
     def _finalize():

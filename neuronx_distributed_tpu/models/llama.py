@@ -344,10 +344,14 @@ class LlamaForCausalLM(nn.Module):
         if cfg.sequence_parallel:
             x = constrain(x, ACT_FULL)
         if cfg.tie_word_embeddings:
-            return model.attend(x.astype(jnp.float32))
+            return model.attend(x)
+        # logits matmul runs in the compute dtype (bf16 MXU rate); the
+        # vocab-parallel CE upcasts to fp32 for the softmax/LSE math
+        # (parallel/loss.py) — fp32 here would force a slow fp32 matmul and
+        # materialize 4-byte logits for no numerical benefit in the loss
         return ColumnParallelLinear(
             cfg.vocab_size, use_bias=False, gather_output=False,
-            dtype=jnp.float32, param_dtype=cfg.param_dtype, name="lm_head",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
         )(x)
 
     def loss(self, input_ids: jax.Array, labels: jax.Array,

@@ -67,9 +67,11 @@ class PipelinedLlama:
             epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=False,
         )
+        # compute dtype matches LlamaForCausalLM's lm_head (bf16 MXU rate);
+        # the CE loss upcasts to fp32 internally
         self._head = ColumnParallelLinear(
             cfg.vocab_size, use_bias=False, gather_output=False,
-            dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
         )
 
     # --- init -----------------------------------------------------------

@@ -122,6 +122,87 @@ def step_memory_bytes(step, state, batch_data):
         return None
 
 
+def bench_inference_ttft(prompt_len=2048, depths=(2, 6), trials=7, decode_steps=20):
+    """Llama-2-13B p50 TTFT + decode throughput (north-star metric #2,
+    BASELINE.md; reference benchmark.py:43-71 percentile method).
+
+    Same slope method as training: measure prefill/decode at 13B layer dims
+    for two depths, fit a + b*L, project to the full 40 layers. TTFT is
+    end-to-end: prompt in, first sampled token fetched on the host (includes
+    the host<->TPU roundtrip, as a serving stack would pay it).
+    """
+    import gc
+
+    from neuronx_distributed_tpu.inference import CausalLM
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, neuronx_distributed_config,
+    )
+
+    FULL = 40  # Llama-2-13B depth
+    prefill_t, decode_t = {}, {}
+    for layers in depths:
+        if ps.model_parallel_is_initialized():
+            ps.destroy_model_parallel()
+        cfg = neuronx_distributed_config(tensor_parallel_size=1)
+        lcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+            num_layers=layers, num_heads=40, num_kv_heads=40,
+            max_seq_len=prompt_len + 512, dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16, use_flash_attention=True,
+            attention_block_q=256, attention_block_k=512, remat_policy=None,
+        )
+        from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
+
+        assert prompt_len >= 128 and flash_supported(
+            prompt_len, lcfg.max_seq_len, lcfg.attention_block_q, lcfg.attention_block_k
+        ), "TTFT config must exercise the flash-prefill path, not dense fallback"
+        ids = jnp.zeros((1, 8), jnp.int32)
+        model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg), ids)
+        lm = CausalLM(lcfg, model.params, LlamaForCausalLM,
+                      buckets=(prompt_len,), max_batch=1).compile()
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 32000, (1, prompt_len)), jnp.int32)
+
+        # TTFT: prefill -> last-token logits -> greedy token on host
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            logits, cache = lm._prefill[prompt_len](lm.params, prompt)
+            tok = int(jnp.argmax(logits[0, -1]))  # host fetch = sync
+            ts.append(time.perf_counter() - t0)
+        prefill_t[layers] = float(np.percentile(ts, 50))
+
+        # decode: chained steps, fetch-synced window
+        tok = jnp.zeros((1, 1), jnp.int32)
+        logits, cache = lm._decode(lm.params, cache, tok)
+        float(logits[0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(decode_steps):
+            logits, cache = lm._decode(lm.params, cache, tok)
+        float(logits[0, 0, 0])
+        decode_t[layers] = (time.perf_counter() - t0) / decode_steps
+
+        del lm, model, cache, logits
+        gc.collect()
+
+    l1, l2 = depths
+    out = {}
+    for name, t in (("ttft", prefill_t), ("decode", decode_t)):
+        b = (t[l2] - t[l1]) / (l2 - l1)
+        a = t[l1] - l1 * b
+        if b <= 0 or a < 0:
+            a, b = 0.0, t[l2] / l2
+        out[name] = a + FULL * b
+    return {
+        "ttft_p50_ms_13b_projected": round(out["ttft"] * 1e3, 1),
+        "decode_ms_per_token_13b_projected": round(out["decode"] * 1e3, 2),
+        "ttft_prompt_len": prompt_len,
+        "ttft_p50_ms_measured": {str(k): round(v * 1e3, 1) for k, v in prefill_t.items()},
+    }
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:  # CPU smoke fallback so the script always emits a line
@@ -159,6 +240,10 @@ def main():
             lcfg.num_heads, lcfg.head_dim_)
     flops_7b = model_flops_per_step(FULL_LAYERS, batch, seq, *dims)
     flops_l2 = model_flops_per_step(2, batch, seq, *dims)
+    try:
+        infer = bench_inference_ttft()
+    except Exception as e:  # keep the primary metric printable regardless
+        infer = {"ttft_error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps({
         "metric": "llama2_7b_train_tokens_per_sec_per_chip",
         "value": round(tok_s_7b, 1),
@@ -170,6 +255,7 @@ def main():
         "step_time_L2_s": round(times[2], 4),
         "batch": batch, "seq": seq,
         "step_memory_bytes_L2": mem,
+        **infer,
     }))
 
 

@@ -61,9 +61,11 @@ class CausalLM:
         buckets: Tuple[int, ...] = (128, 512, 2048),
         max_batch: int = 4,
     ):
+        # keep the caller's use_flash_attention: prefill buckets >= 128 run
+        # the Pallas kernel with position masks (reference prefill gating,
+        # attention_base.py:103-114); decode steps use the dense cached path
         self.config = dataclasses.replace(
-            config, decode=True, use_flash_attention=False,
-            sequence_parallel=False, remat_policy=None,
+            config, decode=True, sequence_parallel=False, remat_policy=None,
         )
         self.params = params
         self.max_batch = max_batch

@@ -2,28 +2,41 @@
 
 Capability-parity with the reference's NKI kernel glue
 (``kernels/flash_attn.py`` — ``NKIAttnFunc``:85, ``nki_flash_attn_func``:151,
-kernels imported at :19-27), but the kernels themselves live here (the
-reference delegates to ``neuronxcc.nki.kernels``; SURVEY §2.2 marks Pallas
-flash attention as the real kernel-engineering workload).
+kernels imported at :19-27) plus the serving-side masked/prefill usage
+(``examples/inference/modules/attention/attention_base.py:103-140``), but the
+kernels themselves live here (the reference delegates to
+``neuronxcc.nki.kernels``; SURVEY §2.2 marks Pallas flash attention as the
+real kernel-engineering workload).
 
-Design (standard flash-attention-2 tiling, written for the MXU/VMEM model):
+Design (flash-attention-2 tiling written for the MXU/VMEM model):
 
 * forward: grid ``(batch*heads, q_blocks, kv_blocks)``, kv innermost. TPU
   grids execute sequentially per core, so VMEM scratch (running max ``m``,
   normalizer ``l``, accumulator ``acc``) carries across the kv iterations of
   one q block; the output and the LSE residual are written at the last kv
-  step. Online softmax in fp32 on the VPU; both matmuls hit the MXU with
-  ``preferred_element_type=fp32``.
+  step. Online softmax in fp32 on the VPU; both matmuls take bf16 operands
+  on the MXU with fp32 accumulation (``preferred_element_type``).
 * backward: recompute-based (no O(S^2) residuals, matching the reference's
   LSE-stash strategy): a ``delta = rowsum(dO*O)`` pre-pass, a dk/dv kernel
   (grid over kv blocks, q innermost) and a dq kernel (grid over q blocks, kv
   innermost), each rebuilding ``p = exp(qk - lse)`` from the stashed LSE.
-* causal masking skips fully-masked blocks via ``pl.when`` predication (the
-  reference's NKI kernel does the analogous triangle skipping).
+* masking is POSITION-BASED and unified: every call carries per-token int32
+  positions for queries and keys, and key ``j`` attends to query ``i`` iff
+  ``kv_pos[j] <= q_pos[i]``. Pure causal is the default (``q_pos = kv_pos =
+  iota``); decode/chunked-prefill against a KV cache passes
+  ``q_pos = cache_len + iota`` and marks unwritten cache slots with a large
+  sentinel; padded prompts mark pad keys with the sentinel and pad query
+  rows with ``-1``. Blocks with no valid pair are skipped via a dynamic
+  ``pl.when`` predicate (for pure causal this reproduces the static triangle
+  skipping exactly — the program_id comparison was already a traced scalar).
+* fully-masked query rows produce output 0 and LSE == NEG_INF (the ``l == 0``
+  guard), so pad rows never NaN.
 
 Unlike the reference's kernel (seq must be a multiple of 2048,
 flash_attn.py:177-179) block sizes adapt down to the sequence length, so any
-seq that is a multiple of the block (default 128) works.
+seq that is a multiple of the block (default 128) works; ``sq != sk`` is
+supported (bottom-aligned causal by default, matching the reference's
+KV-cache decode semantics).
 
 On non-TPU backends (CPU tests) the same kernels run under the Pallas
 interpreter, so unit tests exercise the real kernel code path.
@@ -39,7 +52,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
-LANES = 128  # TPU min lane tile; LSE/delta are stored lane-broadcast
+LANES = 128   # TPU min lane tile; LSE/delta are stored lane-broadcast
+INVALID_POS = 2**30  # kv sentinel: never <= any real query position
 
 
 def _interpret() -> bool:
@@ -50,10 +64,9 @@ def _interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, kv_blocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, kv_blocks):
     ki = pl.program_id(2)
-    qi = pl.program_id(1)
 
     @pl.when(ki == 0)
     def _init():
@@ -61,8 +74,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: skip blocks strictly above the diagonal
-    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    qp = qp_ref[0, :]                               # (block_q,)
+    kp = kp_ref[0, :]                               # (block_k,)
+    # skip blocks with no valid (query, key) pair
+    run = jnp.min(kp) <= jnp.max(qp)
 
     @pl.when(run)
     def _compute():
@@ -74,13 +89,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale                               # (block_q, block_k) fp32
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        valid = kp[None, :] <= qp[:, None]
+        s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:]                          # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # explicit mask on p: for fully-masked rows s - m_new == 0, and
+        # exp(0) == 1 would corrupt the normalizer
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
@@ -92,7 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(ki == kv_blocks - 1)
     def _finalize():
         l = l_scr[:]
-        # rows with no unmasked keys (can't happen for causal self-attn) guard
+        # fully-masked rows (pad queries) have l == 0 -> output 0, LSE NEG_INF
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         # LSE stored broadcast across a 128-lane dim (TPU min tile; same
@@ -105,12 +120,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_scr, dv_scr,
-                     *, sm_scale, causal, block_q, block_k, q_blocks, group):
+                     qp_ref, kp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                     *, sm_scale, q_blocks, group):
     # grid (b*hk, kv_blocks, group, q_blocks): one dk/dv block accumulates
     # over its GQA group's q heads AND all q blocks in consecutive grid steps
     # (TPU output revisiting must be consecutive)
-    ki = pl.program_id(1)
     g = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -119,7 +133,9 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    qp = qp_ref[0, :]
+    kp = kp_ref[0, :]
+    run = jnp.min(kp) <= jnp.max(qp)
 
     @pl.when(run)
     def _compute():
@@ -133,11 +149,10 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                      # (bq, bk) fp32
+        valid = kp[None, :] <= qp[:, None]
+        # masked entries: exp(s - lse) may overflow for pad rows (lse NEG_INF);
+        # the where() selects them away before any use
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)   # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -158,16 +173,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr,
-                   *, sm_scale, causal, block_q, block_k, kv_blocks):
-    qi = pl.program_id(1)
+                   qp_ref, kp_ref, dq_ref, dq_scr, *, sm_scale, kv_blocks):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    qp = qp_ref[0, :]
+    kp = kp_ref[0, :]
+    run = jnp.min(kp) <= jnp.max(qp)
 
     @pl.when(run)
     def _compute():
@@ -181,11 +196,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        valid = kp[None, :] <= qp[:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -200,31 +212,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 # ---------------------------------------------------------------------------
-# public op with custom VJP
+# custom-VJP op over flattened (batch*heads, seq, dim) operands
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bh(q, k, v, causal, sm_scale, block_q, block_k, group):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_bh(q, k, v, qpos, kpos, sm_scale, block_q, block_k,
+                        group, num_q_heads):
     """q: (b*h, sq, d); k/v COMPACT: (b*hk, sk, d) with group = h // hk —
     kernels index the shared kv head via the BlockSpec index_map, so GQA
-    K/V are never materialized per-q-head in HBM."""
-    out, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, group)
+    K/V are never materialized per-q-head in HBM. ``qpos``/``kpos``:
+    (b, 1, s) int32 token positions (see module docstring for semantics)."""
+    out, _ = _fwd(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group, num_q_heads)
     return out
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, group=1):
+def _fwd(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group, num_q_heads):
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     q_blocks = pl.cdiv(sq, block_q)
     kv_blocks = pl.cdiv(sk, block_k)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
-    )
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, kv_blocks=kv_blocks)
     from jax.experimental.pallas import tpu as pltpu
 
+    h = num_q_heads
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, q_blocks, kv_blocks),
@@ -232,6 +244,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, group=1):
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i, j: (b // h, 0, i)),
+            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b // h, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -247,36 +261,37 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, group=1):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, qpos, kpos)
     return out, lse
 
 
-def _flash_fwd_vjp(q, k, v, causal, sm_scale, block_q, block_k, group):
-    out, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, group)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_vjp(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group, num_q_heads):
+    out, lse = _fwd(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group, num_q_heads)
+    return out, (q, k, v, qpos, kpos, out, lse)
 
 
-def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, group, res, do):
+def _flash_bwd_vjp(sm_scale, block_q, block_k, group, num_q_heads, res, do):
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, out, lse = res
+    q, k, v, qpos, kpos, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     q_blocks = pl.cdiv(sq, block_q)
     kv_blocks = pl.cdiv(sk, block_k)
+    h = num_q_heads
     # delta pre-pass: rowsum(do * out) — elementwise, let XLA fuse it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     dkdv_kernel = functools.partial(
-        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, q_blocks=q_blocks, group=group,
+        _bwd_dkdv_kernel, sm_scale=sm_scale, q_blocks=q_blocks, group=group,
     )
     # q row for compact kv row ``bk`` and member ``g`` is bk*group + g
     # (bh = b*h = (b*hk)*group, heads grouped contiguously per kv head)
     hkv = k.shape[0]  # b * hk
+    hk = h // group
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(hkv, kv_blocks, group, q_blocks),
@@ -287,6 +302,8 @@ def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, group, res, do):
             pl.BlockSpec((None, block_q, d), lambda bk, j, g, i: (bk * group + g, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda bk, j, g, i: (bk * group + g, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda bk, j, g, i: (bk * group + g, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda bk, j, g, i: (bk // hk, 0, i)),
+            pl.BlockSpec((None, 1, block_k), lambda bk, j, g, i: (bk // hk, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bk, j, g, i: (bk, j, 0)),
@@ -301,12 +318,9 @@ def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, group, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, qpos, kpos)
 
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_blocks=kv_blocks,
-    )
+    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, kv_blocks=kv_blocks)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, q_blocks, kv_blocks),
@@ -317,16 +331,49 @@ def _flash_bwd_vjp(causal, sm_scale, block_q, block_k, group, res, do):
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i, j: (b // h, 0, i)),
+            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b // h, 0, j)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, do, lse, delta, qpos, kpos)
+    return dq, dk, dv, None, None
 
 
 _flash_attention_bh.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_supported(sq: int, sk: int, block_q: int, block_k: int) -> bool:
+    """True iff the kernel's shape constraints hold (seqs are multiples of
+    the clamped block sizes). Call sites that fall back to dense attention
+    must use THIS predicate so the constraint lives in one place."""
+    return sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0
+
+
+def resolve_positions(b, sq, sk, causal, q_positions, kv_positions):
+    """Fill missing position arrays with the defaults (single source of
+    truth for default-mask semantics across the kernel, the XLA golden, and
+    the sharded dispatch path)."""
+    if q_positions is None or kv_positions is None:
+        dq_pos, dk_pos = default_positions(b, sq, sk, causal)
+        q_positions = dq_pos if q_positions is None else q_positions
+        kv_positions = dk_pos if kv_positions is None else kv_positions
+    return q_positions, kv_positions
+
+
+def default_positions(b, sq, sk, causal):
+    """Default query/key positions: keys at ``iota(sk)``; causal queries
+    bottom-aligned at ``iota(sq) + (sk - sq)`` (for ``sq == sk`` this is the
+    standard causal mask; for ``sq < sk`` the reference's KV-cache decode
+    semantics), non-causal queries all-visible at ``sk - 1``."""
+    kpos = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+    if causal:
+        qpos = jnp.arange(sq, dtype=jnp.int32) + (sk - sq)
+    else:
+        qpos = jnp.full((sq,), sk - 1, jnp.int32)
+    return jnp.broadcast_to(qpos, (b, sq)), kpos
 
 
 def flash_attention(
@@ -337,6 +384,8 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention over ``(batch, num_heads, seq, head_dim)`` tensors
     (reference ``nki_flash_attn_func``, kernels/flash_attn.py:151 — same
@@ -345,6 +394,14 @@ def flash_attention(
     GQA: ``k``/``v`` may have fewer heads; the kernels index the shared kv
     head through the BlockSpec index_map (``row // group``), so K/V stay at
     their compact size in HBM — no ``jnp.repeat`` materialization.
+
+    Masking: key ``j`` is visible to query ``i`` iff
+    ``kv_positions[b, j] <= q_positions[b, i]``. Defaults give (bottom-
+    aligned) causal or full visibility per ``causal``. Pass explicit int32
+    position arrays ((b, sq) and (b, sk)) for padded prompts (pad keys →
+    ``INVALID_POS``, pad query rows → ``-1``) or KV-cache decode
+    (``q_positions = cache_len + iota``, unwritten cache slots →
+    ``INVALID_POS``). Gradients flow through q/k/v only.
     """
     b, h, sq, d = q.shape
     hk = k.shape[1]
@@ -353,28 +410,31 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     sk = k.shape[2]
-    if sq % min(block_q, sq) != 0 or sk % min(block_k, sk) != 0:
+    if not flash_supported(sq, sk, block_q, block_k):
         raise ValueError(
             f"seq lengths (q={sq}, kv={sk}) must be multiples of the block sizes "
             f"(block_q={block_q}, block_k={block_k}); pad the sequence or pass "
             f"smaller blocks (edge blocks are not masked)"
         )
-    if causal and sq != sk:
-        raise ValueError(
-            f"causal flash attention requires sq == sk (got {sq} vs {sk}); "
-            f"decode-style sq<sk calls should use reference_attention "
-            f"(bottom-aligned mask semantics)"
-        )
+    q_positions, kv_positions = resolve_positions(
+        b, sq, sk, causal, q_positions, kv_positions
+    )
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * hk, sk, d)
     vf = v.reshape(b * hk, sk, d)
-    out = _flash_attention_bh(qf, kf, vf, causal, float(sm_scale), block_q, block_k, h // hk)
+    qp = q_positions.astype(jnp.int32).reshape(b, 1, sq)
+    kp = kv_positions.astype(jnp.int32).reshape(b, 1, sk)
+    out = _flash_attention_bh(
+        qf, kf, vf, qp, kp, float(sm_scale), block_q, block_k, h // hk, h
+    )
     return out.reshape(b, h, sq, d)
 
 
-def reference_attention(q, k, v, causal=True, sm_scale=None):
+def reference_attention(q, k, v, causal=True, sm_scale=None,
+                        q_positions=None, kv_positions=None):
     """Plain-XLA attention, used as the numerical golden in tests (the role
-    of the reference's CPU-control modules, SURVEY §4.2)."""
+    of the reference's CPU-control modules, SURVEY §4.2). Supports the same
+    position-based masking as :func:`flash_attention`."""
     b, h, sq, d = q.shape
     hk = k.shape[1]
     if hk != h:
@@ -382,10 +442,15 @@ def reference_attention(q, k, v, causal=True, sm_scale=None):
         v = jnp.repeat(v, h // hk, axis=1)
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
+    sk = k.shape[2]
+    q_positions, kv_positions = resolve_positions(
+        b, sq, sk, causal, q_positions, kv_positions
+    )
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
-    if causal:
-        sk = k.shape[2]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, NEG_INF)
+    mask = kv_positions[:, None, None, :] <= q_positions[:, None, :, None]
+    s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows: softmax over all NEG_INF is uniform garbage — zero it
+    any_valid = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(any_valid, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -212,7 +212,34 @@ class LlamaAttention(nn.Module):
         ck.value = ck.value.at[rows, positions].set(k.astype(ck.value.dtype))
         cv.value = cv.value.at[rows, positions].set(v.astype(cv.value.dtype))
         ci.value = idx + s_new
-        o = cached_attention(q, ck.value, cv.value, idx)
+        # prefill/chunk attention: the Pallas kernel with per-slot position
+        # masks (q at idx..idx+s_new; key j visible iff j <= q position, which
+        # also excludes unwritten cache slots). The reference likewise uses
+        # flash attention for prefill above a length threshold
+        # (attention_base.py:103-114); short decode steps use the dense path.
+        from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
+
+        blk_q = min(cfg.attention_block_q, s_new)
+        use_flash = (
+            cfg.use_flash_attention
+            and s_new >= 128
+            and flash_supported(s_new, cfg.max_seq_len, blk_q, cfg.attention_block_k)
+        )
+        if use_flash:
+            o = attention(
+                q.transpose(0, 2, 1, 3),
+                ck.value.transpose(0, 2, 1, 3),
+                cv.value.transpose(0, 2, 1, 3),
+                causal=False,
+                use_flash=True,
+                block_q=blk_q,
+                block_k=cfg.attention_block_k,
+                q_positions=positions,
+                kv_positions=None,  # default iota: j <= q position
+            )
+            o = o.transpose(0, 2, 1, 3)
+        else:
+            o = cached_attention(q, ck.value, cv.value, idx)
         o = o.reshape(b, s_new, -1)
         return self._o_proj(o)
 

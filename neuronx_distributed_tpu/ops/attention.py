@@ -16,7 +16,6 @@ model calls :func:`attention`, which
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -37,20 +36,37 @@ def attention(
     use_flash: bool = True,
     block_q: int = 128,
     block_k: int = 128,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention over BHSD tensors; K/V may carry fewer (GQA)
-    heads. Heads must be TP-sharded (the GQA QKV layer's output layout)."""
+    heads. Heads must be TP-sharded (the GQA QKV layer's output layout).
+
+    ``q_positions``/``kv_positions`` ((b, sq)/(b, sk) int32) select the
+    position-based mask (padded prompts, KV-cache decode — see
+    kernels/flash_attn.py); defaults are (bottom-aligned) causal."""
     if not use_flash:
-        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   q_positions=q_positions, kv_positions=kv_positions)
     if not ps.model_parallel_is_initialized():
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               q_positions=q_positions, kv_positions=kv_positions)
     mesh = ps.get_mesh()
     spec = P(DP_AXES, TP_AXIS, None, None)
-    fn = functools.partial(
-        flash_attention, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k
+    pos_spec = P(DP_AXES, None)  # positions are per-batch, replicated over TP
+    from neuronx_distributed_tpu.kernels.flash_attn import resolve_positions
+
+    q_positions, kv_positions = resolve_positions(
+        q.shape[0], q.shape[2], k.shape[2], causal, q_positions, kv_positions
     )
+
+    def call(q, k, v, qp, kp):
+        return flash_attention(q, k, v, sm_scale=sm_scale, block_q=block_q,
+                               block_k=block_k, q_positions=qp, kv_positions=kp)
+
     # check_vma=False: pallas_call out_shapes don't carry vma annotations
     return shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )(q, k, v)
+        call, mesh=mesh, in_specs=(spec, spec, spec, pos_spec, pos_spec),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, q_positions, kv_positions)

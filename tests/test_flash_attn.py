@@ -96,3 +96,97 @@ def test_gqa_gradients_compact_kv():
             np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
             err_msg=f"GQA grad mismatch for {name}",
         )
+
+
+# --- position-based masking (padding, KV-cache decode, sq<sk) --------------
+
+def _masked_golden(q, k, v, qpos, kpos):
+    """Dense fp32 golden with the position mask applied by hand."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    mask = kpos[:, None, None, :] <= qpos[:, None, :, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.any(mask, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_padded_prompt_mask():
+    """Right-padded prompts: pad keys carry INVALID_POS, pad query rows -1;
+    real rows match the golden, pad rows are exactly zero."""
+    from neuronx_distributed_tpu.kernels.flash_attn import INVALID_POS
+
+    b, h, s, d = 2, 2, 128, 32
+    lengths = np.array([96, 50])
+    q = _rand((b, h, s, d), 30)
+    k = _rand((b, h, s, d), 31)
+    v = _rand((b, h, s, d), 32)
+    iota = np.arange(s)
+    qpos = jnp.asarray(np.where(iota[None] < lengths[:, None], iota[None], -1), jnp.int32)
+    kpos = jnp.asarray(np.where(iota[None] < lengths[:, None], iota[None], INVALID_POS), jnp.int32)
+    out = flash_attention(q, k, v, block_q=64, block_k=64,
+                          q_positions=qpos, kv_positions=kpos)
+    ref = _masked_golden(q, k, v, qpos, kpos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    for i, L in enumerate(lengths):
+        assert np.all(np.asarray(out)[i, :, L:, :] == 0.0), "pad rows must be zero"
+
+
+def test_decode_chunk_against_cache():
+    """sq < sk with per-slot cache offsets (chunked prefill / speculation):
+    query i of slot b sits at cache_len[b] + i and sees keys j <= that."""
+    b, h, d = 2, 2, 32
+    s_new, s_max = 64, 256
+    cache_len = np.array([100, 7])
+    q = _rand((b, h, s_new, d), 33)
+    k = _rand((b, h, s_max, d), 34)
+    v = _rand((b, h, s_max, d), 35)
+    qpos = jnp.asarray(cache_len[:, None] + np.arange(s_new)[None], jnp.int32)
+    kpos = jnp.broadcast_to(jnp.arange(s_max, dtype=jnp.int32), (b, s_max))
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64,
+                          q_positions=qpos, kv_positions=kpos)
+    ref = _masked_golden(q, k, v, qpos, kpos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_bottom_aligned_default_sq_lt_sk():
+    """causal with sq<sk defaults to bottom-aligned positions (the decode
+    convention the old kernel rejected)."""
+    q = _rand((1, 2, 64, 32), 36)
+    k = _rand((1, 2, 128, 32), 37)
+    v = _rand((1, 2, 128, 32), 38)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    qpos = jnp.asarray(np.arange(64)[None] + 64, jnp.int32)
+    kpos = jnp.asarray(np.arange(128)[None], jnp.int32)
+    ref = _masked_golden(q, k, v, qpos, kpos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_masked_gradients():
+    """Grads flow through the masked kernel and match the dense golden,
+    including zero grads into pad positions."""
+    from neuronx_distributed_tpu.kernels.flash_attn import INVALID_POS
+
+    b, h, s, d = 1, 2, 128, 32
+    L = 80
+    q = _rand((b, h, s, d), 40)
+    k = _rand((b, h, s, d), 41)
+    v = _rand((b, h, s, d), 42)
+    iota = np.arange(s)
+    qpos = jnp.asarray(np.where(iota[None] < L, iota[None], -1), jnp.int32)
+    kpos = jnp.asarray(np.where(iota[None] < L, iota[None], INVALID_POS), jnp.int32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=64,
+                                       q_positions=qpos, kv_positions=kpos) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_masked_golden(q, k, v, qpos, kpos) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
+            err_msg=f"masked grad mismatch for {name}",
+        )
+    assert np.all(np.asarray(g_flash[1])[:, :, L:, :] == 0.0), "pad-key grads must be zero"

@@ -166,3 +166,43 @@ def test_generate_overflow_guard():
     lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=1).compile()
     with pytest.raises(ValueError, match="max_seq_len"):
         lm.generate(ids, max_new_tokens=100)
+
+
+def test_flash_prefill_matches_dense_prefill():
+    """The flash-prefill path (s_new >= 128, position-masked Pallas kernel
+    against the KV cache) must produce the same logits as the dense cached
+    path — the serving-side TTFT optimization cannot change numerics."""
+    cfg_dense = LlamaConfig(**{**TINY, "max_seq_len": 256})
+    cfg_flash = dataclasses.replace(
+        cfg_dense, use_flash_attention=True,
+        attention_block_q=64, attention_block_k=64,
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(5), (2, 128), 1, 127)
+    params = _params(cfg_dense, ids)
+    dense, mut_d = LlamaForCausalLM(dataclasses.replace(cfg_dense, decode=True)).apply(
+        {"params": params}, ids, mutable=["cache"])
+    flash, mut_f = LlamaForCausalLM(dataclasses.replace(cfg_flash, decode=True)).apply(
+        {"params": params}, ids, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-3, atol=2e-3)
+    # caches identical (flash only changes the attention read, not the write)
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(mut_d["cache"]),
+        jax.tree_util.tree_leaves_with_path(mut_f["cache"]),
+    ):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5, atol=1e-5)
+
+
+def test_generate_flash_prefill_end_to_end():
+    """CausalLM.generate with flash prefill enabled matches the dense-config
+    generation token-for-token (greedy)."""
+    cfg = LlamaConfig(**{**TINY, "max_seq_len": 256})
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 1, 127)
+    params = _params(cfg, ids)
+    prompts = np.asarray(jax.random.randint(jax.random.PRNGKey(7), (2, 130), 1, 127))
+    out = {}
+    for name, flash in (("dense", False), ("flash", True)):
+        c = dataclasses.replace(
+            cfg, use_flash_attention=flash, attention_block_q=64, attention_block_k=64)
+        lm = CausalLM(c, params, LlamaForCausalLM, buckets=(192,), max_batch=2)
+        out[name] = lm.generate(prompts, max_new_tokens=4).tokens
+    np.testing.assert_array_equal(out["dense"], out["flash"])

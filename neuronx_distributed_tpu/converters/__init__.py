@@ -1,0 +1,10 @@
+"""Offline checkpoint converters (reference ``scripts/checkpoint_converter.py``
+— ``CheckpointConverterBase``:20, ``convert_full_state_to_tp``:393,
+``merge_tp_checkpoints``:238). See SURVEY.md §2 component 47."""
+
+from neuronx_distributed_tpu.converters.hf_llama import (  # noqa: F401
+    hf_to_nxd_llama,
+    load_hf_safetensors,
+    nxd_to_hf_llama,
+    save_hf_safetensors,
+)

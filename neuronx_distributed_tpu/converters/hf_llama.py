@@ -1,0 +1,232 @@
+"""HF ↔ framework checkpoint conversion for the Llama family.
+
+Reference: ``scripts/checkpoint_converter.py`` (``CheckpointConverterBase``:20
+— ``convert_full_state_to_tp``:393 splits a full HF state across TP/PP ranks
+with QKV fuse and GQA KV replication; ``merge_tp_checkpoints``:238 inverts
+it). On TPU the per-rank splitting dissolves: the framework's params are ONE
+global pytree laid out by GSPMD, so conversion is a pure layout transform —
+torch (out, in) kernels transpose to (in, out), per-layer tensors stack on
+the scan axis, and GQA K/V stay in the framework's COMPACT ``num_kv_heads``
+layout (the reference's ``kv_size_multiplier`` replication is a runtime
+forward concern here, never a checkpoint one — parallel/layers.py GQA notes).
+
+The fused-QKV variant of the reference (``qkv_linear.py`` fused weights) is
+supported on the HF side via ``fused_qkv=True`` (one ``self_attn.qkv_proj``
+matrix ``[q; k; v]`` rows).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------- IO helpers
+
+def load_hf_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Read an HF checkpoint: a single ``.safetensors`` file, or a directory
+    containing one or more shards (``model-0000x-of-0000y.safetensors``)."""
+    from safetensors.numpy import load_file
+
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files under {path}")
+    else:
+        files = [path]
+    state: Dict[str, np.ndarray] = {}
+    for f in files:
+        state.update(load_file(f))
+    return state
+
+
+def save_hf_safetensors(state: Dict[str, np.ndarray], path: str) -> None:
+    from safetensors.numpy import save_file
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in state.items()}, path)
+
+
+def _np(x, dtype=None) -> np.ndarray:
+    """jnp/bf16-safe host fetch: bf16 → fp32 unless a target dtype is given."""
+    a = np.asarray(x) if getattr(x, "dtype", None) != "bfloat16" else np.asarray(
+        x, dtype=np.float32
+    )
+    if str(getattr(x, "dtype", "")) == "bfloat16" and dtype is None:
+        dtype = np.float32
+    return a.astype(dtype) if dtype is not None else a
+
+
+# ------------------------------------------------------------- HF → framework
+
+def hf_to_nxd_llama(
+    hf: Dict[str, np.ndarray],
+    config,
+    dtype: Optional[Any] = None,
+    fused_qkv: bool = False,
+) -> PyTree:
+    """Map a full HF Llama state dict onto the framework's param pytree
+    (reference ``convert_full_state_to_tp``:393 direction, minus per-rank
+    splitting). Shapes follow models/llama.py: q_kernel (L,H,N,D), compact
+    k/v (L,H,Nkv,D), transposed 2D kernels, scan-stacked layers."""
+    import jax.numpy as jnp
+
+    cfg = config
+    L, H = cfg.num_layers, cfg.hidden_size
+    N, Nkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    dt = dtype or cfg.param_dtype
+
+    def t(name):  # torch (out, in) -> (in, out)
+        return _np(hf[name]).T
+
+    def qkv(i):
+        if fused_qkv:
+            w = _np(hf[f"model.layers.{i}.self_attn.qkv_proj.weight"])  # (ND+2NkvD, H)
+            q, k, v = np.split(w, [N * D, N * D + Nkv * D], axis=0)
+        else:
+            q = _np(hf[f"model.layers.{i}.self_attn.q_proj.weight"])
+            k = _np(hf[f"model.layers.{i}.self_attn.k_proj.weight"])
+            v = _np(hf[f"model.layers.{i}.self_attn.v_proj.weight"])
+        return (
+            q.T.reshape(H, N, D),
+            k.T.reshape(H, Nkv, D),
+            v.T.reshape(H, Nkv, D),
+        )
+
+    qs, ks, vs = zip(*(qkv(i) for i in range(L)))
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    block = {
+        "attention": {
+            "qkv": {
+                "q_kernel": np.stack(qs),
+                "k_kernel": np.stack(ks),
+                "v_kernel": np.stack(vs),
+            },
+            "o_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.self_attn.o_proj.weight"))},
+        },
+        "mlp": {
+            "gate_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.gate_proj.weight"))},
+            "up_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.up_proj.weight"))},
+            "down_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.down_proj.weight"))},
+        },
+        "input_norm": {"scale": stack(lambda i: _np(hf[f"model.layers.{i}.input_layernorm.weight"]))},
+        "post_attn_norm": {
+            "scale": stack(lambda i: _np(hf[f"model.layers.{i}.post_attention_layernorm.weight"]))
+        },
+    }
+    params = {
+        "model": {
+            "embed": {"embedding": _np(hf["model.embed_tokens.weight"])},
+            "layers": {"block": block},
+            "final_norm": {"scale": _np(hf["model.norm.weight"])},
+        }
+    }
+    if not cfg.tie_word_embeddings:
+        lm = hf.get("lm_head.weight", hf["model.embed_tokens.weight"])
+        params["lm_head"] = {"kernel": _np(lm).T}
+    import jax
+
+    return jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+
+
+# ------------------------------------------------------------- framework → HF
+
+def nxd_to_hf_llama(
+    params: PyTree,
+    config,
+    dtype: Any = np.float32,
+    fused_qkv: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Inverse mapping (reference ``merge_tp_checkpoints``:238 direction)."""
+    cfg = config
+    L, H = cfg.num_layers, cfg.hidden_size
+    N, Nkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    blk = params["model"]["layers"]["block"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["model"]["embed"]["embedding"], dtype),
+        "model.norm.weight": _np(params["model"]["final_norm"]["scale"], dtype),
+    }
+    if "lm_head" in params:
+        out["lm_head.weight"] = _np(params["lm_head"]["kernel"], dtype).T
+    for i in range(L):
+        q = _np(blk["attention"]["qkv"]["q_kernel"][i], dtype).reshape(H, N * D).T
+        k = _np(blk["attention"]["qkv"]["k_kernel"][i], dtype).reshape(H, Nkv * D).T
+        v = _np(blk["attention"]["qkv"]["v_kernel"][i], dtype).reshape(H, Nkv * D).T
+        if fused_qkv:
+            out[f"model.layers.{i}.self_attn.qkv_proj.weight"] = np.concatenate([q, k, v])
+        else:
+            out[f"model.layers.{i}.self_attn.q_proj.weight"] = q
+            out[f"model.layers.{i}.self_attn.k_proj.weight"] = k
+            out[f"model.layers.{i}.self_attn.v_proj.weight"] = v
+        out[f"model.layers.{i}.self_attn.o_proj.weight"] = _np(
+            blk["attention"]["o_proj"]["kernel"][i], dtype).T
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            out[f"model.layers.{i}.mlp.{name}.weight"] = _np(blk["mlp"][name]["kernel"][i], dtype).T
+        out[f"model.layers.{i}.input_layernorm.weight"] = _np(blk["input_norm"]["scale"][i], dtype)
+        out[f"model.layers.{i}.post_attention_layernorm.weight"] = _np(
+            blk["post_attn_norm"]["scale"][i], dtype)
+    return out
+
+
+def config_from_hf(path: str):
+    """Build a LlamaConfig from an HF ``config.json`` (reference reads the HF
+    config for head counts the same way, checkpoint_converter.py)."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    with open(os.path.join(path, "config.json") if os.path.isdir(path) else path) as f:
+        hc = json.load(f)
+    return LlamaConfig(
+        vocab_size=hc["vocab_size"],
+        hidden_size=hc["hidden_size"],
+        intermediate_size=hc["intermediate_size"],
+        num_layers=hc["num_hidden_layers"],
+        num_heads=hc["num_attention_heads"],
+        num_kv_heads=hc.get("num_key_value_heads", hc["num_attention_heads"]),
+        max_seq_len=hc.get("max_position_embeddings", 4096),
+        rope_theta=hc.get("rope_theta", 10000.0),
+        rms_norm_eps=hc.get("rms_norm_eps", 1e-5),
+        tie_word_embeddings=hc.get("tie_word_embeddings", False),
+    )
+
+
+def main(argv=None):
+    """CLI: ``python -m neuronx_distributed_tpu.converters.hf_llama`` —
+    the reference ships the analogous offline tool as a script entry
+    (checkpoint_converter.py argparse main)."""
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--input", required=True, help="HF dir/file, or framework ckpt dir")
+    p.add_argument("--output", required=True)
+    p.add_argument("--direction", choices=["hf2nxd", "nxd2hf"], default="hf2nxd")
+    p.add_argument("--config", help="HF config.json (defaults to <input>/config.json)")
+    p.add_argument("--fused-qkv", action="store_true")
+    args = p.parse_args(argv)
+    cfg = config_from_hf(args.config or args.input)
+    if args.direction == "hf2nxd":
+        params = hf_to_nxd_llama(load_hf_safetensors(args.input), cfg,
+                                 fused_qkv=args.fused_qkv)
+        from neuronx_distributed_tpu.checkpoint import save_checkpoint
+
+        save_checkpoint(args.output, tag="converted", state=params, async_save=False)
+    else:
+        from neuronx_distributed_tpu.checkpoint import load_checkpoint
+
+        params, _ = load_checkpoint(args.input, tag="converted")
+        save_hf_safetensors(
+            nxd_to_hf_llama(params, cfg, fused_qkv=args.fused_qkv),
+            os.path.join(args.output, "model.safetensors"),
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,16 @@ def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def infer_prompt_lengths(prompt_ids: np.ndarray, pad_token_id: int = 0) -> np.ndarray:
+    """Length of each right-padded prompt = 1 + rightmost non-pad position.
+    Robust to ``pad_token_id`` occurring INSIDE a prompt (only the trailing
+    pad run is excluded) — a plain ``(ids != pad).sum()`` is not."""
+    nonpad = np.asarray(prompt_ids) != pad_token_id
+    s = prompt_ids.shape[1]
+    last = s - 1 - np.argmax(nonpad[:, ::-1], axis=1)   # rightmost True
+    return np.where(nonpad.any(axis=1), last + 1, 0).astype(np.int32)
+
+
 @dataclasses.dataclass
 class GenerationResult:
     tokens: np.ndarray          # (b, max_new_tokens), eos-padded
@@ -117,10 +127,14 @@ class CausalLM:
         sampler: Optional[Sampler] = None,
         eos_token_id: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        lengths: Optional[np.ndarray] = None,
+        pad_token_id: int = 0,
     ) -> GenerationResult:
         """Batched generate (reference runner.generate / benchmark path).
-        ``prompt_ids``: (b, s) right-padded with zeros; zero rows beyond a
-        prompt's true length are ignored via per-slot lengths."""
+        ``prompt_ids``: (b, s) right-padded with ``pad_token_id``. Pass
+        explicit per-prompt ``lengths`` when the pad id can legitimately
+        appear inside a prompt — otherwise lengths are inferred from the
+        rightmost non-pad position."""
         if self._decode is None:
             self.compile()
         sampler = sampler or Sampler(greedy=True)
@@ -128,8 +142,11 @@ class CausalLM:
         b, s = prompt_ids.shape
         if b > self.max_batch:
             raise ValueError(f"batch {b} exceeds max_batch {self.max_batch}")
-        lengths = np.asarray((prompt_ids != 0).sum(axis=1), np.int32)
-        lengths = np.maximum(lengths, 1)
+        if lengths is None:
+            lengths = infer_prompt_lengths(prompt_ids, pad_token_id)
+        lengths = np.maximum(np.asarray(lengths, np.int32), 1)
+        if lengths.shape != (b,):
+            raise ValueError(f"lengths shape {lengths.shape} != ({b},)")
         if int(lengths.max()) + max_new_tokens > self.config.max_seq_len:
             raise ValueError(
                 f"prompt ({int(lengths.max())}) + max_new_tokens ({max_new_tokens}) "

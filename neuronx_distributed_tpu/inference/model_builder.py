@@ -67,7 +67,18 @@ class NxDModel:
         (same contract as the reference's padded execution)."""
         entries = self._entries[key]
         in_shapes = _shapes(args)
+
+        def padded_elements(b_shapes):
+            """Total extra elements the buckets add over the inputs — the
+            routing cost. Lexicographic shape order can prefer a bucket with
+            far more padding ((4,2048) over (8,128) for a (2,100) input)."""
+            return sum(
+                int(np.prod(bs)) - int(np.prod(s))
+                for bs, s in zip(b_shapes, in_shapes)
+            )
+
         best = None
+        best_cost = None
         for e in entries:
             b_shapes = _shapes(e.example_args)
             if b_shapes == in_shapes:
@@ -77,8 +88,9 @@ class NxDModel:
                 len(bs) == len(s) and all(bd >= d for bd, d in zip(bs, s))
                 for bs, s in zip(b_shapes, in_shapes)
             ):
-                if best is None or _shapes(best.example_args) > b_shapes:
-                    best = e
+                cost = padded_elements(b_shapes)
+                if best is None or cost < best_cost:
+                    best, best_cost = e, cost
         if best is None:
             raise ValueError(f"no bucket of {key!r} fits input shapes {in_shapes}")
 

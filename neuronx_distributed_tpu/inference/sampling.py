@@ -25,8 +25,14 @@ class Sampler:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / self.temperature
         if self.top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[..., -self.top_k][..., None]
-            logits = jnp.where(logits < kth, -1e30, logits)
+            vocab = logits.shape[-1]
+            if self.top_k > vocab:
+                raise ValueError(f"top_k {self.top_k} exceeds vocab size {vocab}")
+            # exactly-k keep mask via lax.top_k indices — a >=threshold mask
+            # would admit every logit tied at the k-th value
+            _, idx = jax.lax.top_k(logits, self.top_k)
+            keep = jnp.any(jnp.arange(vocab) == idx[..., None], axis=-2)
+            logits = jnp.where(keep, logits, -1e30)
         if self.top_p is not None:
             sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
             probs = jax.nn.softmax(sorted_logits, axis=-1)

@@ -23,7 +23,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult, _set_cache_index
+from neuronx_distributed_tpu.inference.causal_lm import (
+    CausalLM,
+    GenerationResult,
+    _set_cache_index,
+    infer_prompt_lengths,
+)
 
 
 def speculative_generate(
@@ -32,10 +37,14 @@ def speculative_generate(
     prompt_ids: np.ndarray,
     max_new_tokens: int,
     num_draft: int = 4,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    prompt_length: Optional[int] = None,
 ) -> GenerationResult:
     """Greedy assisted decoding. ``target``/``draft`` must be compiled (or
     compilable) CausalLMs with identical tokenizers; batch size 1 per call
-    (the reference's assisted loop is also per-sequence)."""
+    (the reference's assisted loop is also per-sequence). Stops at
+    ``eos_token_id`` like the reference's assisted decoding."""
     if prompt_ids.shape[0] != 1:
         raise ValueError("speculative_generate handles batch size 1")
     if target._decode is None:
@@ -52,16 +61,19 @@ def speculative_generate(
 
     b = target.max_batch
     s = prompt_ids.shape[1]
-    length0 = int((prompt_ids[0] != 0).sum())
-    if length0 + max_new_tokens + num_draft + 1 > target.config.max_seq_len:
+    length = (
+        int(prompt_length)
+        if prompt_length is not None
+        else int(infer_prompt_lengths(prompt_ids, pad_token_id)[0])
+    )
+    if length + max_new_tokens + num_draft + 1 > target.config.max_seq_len:
         raise ValueError(
-            f"prompt ({length0}) + max_new_tokens ({max_new_tokens}) + draft window "
+            f"prompt ({length}) + max_new_tokens ({max_new_tokens}) + draft window "
             f"({num_draft + 1}) exceeds max_seq_len {target.config.max_seq_len}"
         )
     bucket = target._bucket_for(s)
     ids = np.zeros((b, bucket), np.int32)
     ids[0, :s] = prompt_ids[0]
-    length = int((prompt_ids[0] != 0).sum())
 
     t_logits, t_cache = target._prefill[bucket](target.params, jnp.asarray(ids))
     d_logits, d_cache = draft._prefill[bucket](draft.params, jnp.asarray(ids))
@@ -78,7 +90,9 @@ def speculative_generate(
 
     out: list[int] = [last_tok]
     cur_len = length
-    while len(out) < max_new_tokens:
+    while len(out) < max_new_tokens and (
+        eos_token_id is None or out[-1] != eos_token_id
+    ):
         # draft proposes num_draft tokens by plain decode
         proposals = []
         tok = out[-1]
@@ -97,6 +111,10 @@ def speculative_generate(
         while accepted < num_draft and proposals[accepted] == greedy[accepted]:
             accepted += 1
         new_tokens = proposals[:accepted] + [int(greedy[accepted])]
+        if eos_token_id is not None and eos_token_id in new_tokens:
+            # stop at EOS: drop everything past it (reference assisted
+            # decoding stops on eos_token_id)
+            new_tokens = new_tokens[: new_tokens.index(eos_token_id) + 1]
         out.extend(new_tokens)
         cur_len += len(new_tokens)
         # Draft cache bookkeeping. The draft loop wrote K/V for its γ inputs
@@ -117,6 +135,7 @@ def speculative_generate(
         t_cache = _set_cache_index(t_cache, jnp.asarray(lens))
         d_cache = _set_cache_index(d_cache, jnp.asarray(lens))
 
+    out = out[:max_new_tokens]
     tokens = np.zeros((1, max_new_tokens), np.int64)
-    tokens[0] = out[:max_new_tokens]
-    return GenerationResult(tokens=tokens, lengths=np.asarray([max_new_tokens], np.int32))
+    tokens[0, : len(out)] = out
+    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32))

@@ -100,6 +100,25 @@ def merge_lora(params: PyTree, lora_params: PyTree, config: LoraConfig) -> PyTre
     return jax.tree_util.tree_map_with_path(merge_leaf, params)
 
 
+def dropout_adapters(lora_params: PyTree, config: LoraConfig, rng: jax.Array) -> PyTree:
+    """LoRA dropout in weight space: reference applies dropout(x) @ A
+    (layer.py lora_dropout). Feature-wise dropout of x is exactly a row mask
+    on A (``dropout(x) @ A == x @ (diag(m)/keep @ A)`` when the mask is
+    per-feature); the per-token component of standard dropout is not
+    expressible in weight space, so this is the documented approximation —
+    same expected regularization, shared across the microbatch."""
+    if config.lora_dropout <= 0.0:
+        return lora_params
+    keep = 1.0 - config.lora_dropout
+    out = {}
+    for i, (pstr, ad) in enumerate(sorted(lora_params.items())):
+        mask = jax.random.bernoulli(
+            jax.random.fold_in(rng, i), keep, (ad["lora_a"].shape[0], 1)
+        )
+        out[pstr] = {"lora_a": ad["lora_a"] * mask / keep, "lora_b": ad["lora_b"]}
+    return out
+
+
 def lora_param_specs(lora_params: PyTree, params: PyTree,
                      param_specs: PyTree) -> PyTree:
     """Shardings for A/B derived from the base kernel's spec: A keeps the

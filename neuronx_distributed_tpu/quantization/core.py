@@ -34,6 +34,9 @@ class QuantizationConfig:
     quantized_dtype: Any = jnp.int8
     target_patterns: Tuple[str, ...] = ("kernel",)    # leaf-name match
     exclude_patterns: Tuple[str, ...] = ("embed", "lm_head", "norm", "bias")
+    # 3D leaves matching these have a leading batch dim (experts (E,H,I)):
+    # fan-in is then axis 1, so each expert keeps its own scales
+    expert_patterns: Tuple[str, ...] = ("expert", "moe", "mlp_fused")
 
 
 def _is_target(pstr: str, cfg: QuantizationConfig) -> bool:
@@ -58,8 +61,15 @@ def quantize_params(params: PyTree, config: Optional[QuantizationConfig] = None)
             return leaf
         w = jnp.asarray(leaf, jnp.float32)
         if config.quantization_type == "per_channel_symmetric":
-            # scale per output channel (last dim), reference observer.py:12
-            absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+            # Reduce over the fan-in axis ONLY (reference observer.py:12 is
+            # per output channel): a 2D (in, out) kernel reduces axis 0; a 3D
+            # GQA kernel (H, N, D) also reduces axis 0 so every (head, dim)
+            # output channel keeps its own scale; a 3D expert kernel (E, H, I)
+            # reduces axis 1 so scales stay per (expert, out channel).
+            fan_in_axis = 0
+            if w.ndim >= 3 and any(re.search(p, pstr) for p in config.expert_patterns):
+                fan_in_axis = 1
+            absmax = jnp.max(jnp.abs(w), axis=fan_in_axis, keepdims=True)
         elif config.quantization_type == "per_tensor_symmetric":
             absmax = jnp.max(jnp.abs(w))
         else:

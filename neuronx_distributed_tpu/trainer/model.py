@@ -36,12 +36,19 @@ class ParallelModel:
     ``apply`` mirrors the reference ``NxDModel``'s uniform call surface
     (trainer/model.py:34-39); params are global ``jax.Array``s laid out on
     the mesh per the specs the layers declared via ``nn.with_partitioning``.
+
+    When the config carried a ``lora_config`` (reference trainer.py phase 4,
+    LoraModel wrap), ``lora_params`` holds the adapter tree and the train
+    step differentiates ONLY it — the base stays frozen by construction.
     """
 
     module: nn.Module
     params: PyTree
     param_specs: PyTree
     mesh: jax.sharding.Mesh
+    lora_config: Optional[Any] = None
+    lora_params: Optional[PyTree] = None
+    lora_specs: Optional[PyTree] = None
 
     def apply(self, params: PyTree, *args, **kwargs):
         return self.module.apply({"params": params}, *args, **kwargs)
@@ -51,8 +58,60 @@ class ParallelModel:
 
         return specs_to_shardings(self.param_specs, self.mesh)
 
+    @property
+    def trainable_params(self) -> PyTree:
+        return self.lora_params if self.lora_config is not None else self.params
+
+    @property
+    def trainable_specs(self) -> PyTree:
+        return self.lora_specs if self.lora_config is not None else self.param_specs
+
+    def trainable_shardings(self) -> PyTree:
+        from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
+
+        return specs_to_shardings(self.trainable_specs, self.mesh)
+
+    def merged_params(self, lora_params: Optional[PyTree] = None) -> PyTree:
+        """Full params with the adapter delta folded in (reference
+        merge_lora:357); identity when LoRA is off."""
+        if self.lora_config is None:
+            return self.params
+        from neuronx_distributed_tpu.lora.core import merge_lora
+
+        return merge_lora(
+            self.params,
+            self.lora_params if lora_params is None else lora_params,
+            self.lora_config,
+        )
+
     def num_params(self) -> int:
         return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
+
+
+def _apply_config_overrides(module: nn.Module, nxd_config: Dict[str, Any]) -> nn.Module:
+    """Make the trainer config REAL on the model (reference trainer.py phases
+    4-6 wire lora/pad/activation-ckpt; here dtype + remat + SP ride on the
+    model's own dataclass config). Only keys the user explicitly set are
+    applied, so model-level choices are never silently clobbered by defaults.
+    Requires the module to expose a dataclass ``config`` and be rebuildable
+    as ``type(module)(new_config)`` (all in-repo model families are)."""
+    cfg = getattr(module, "config", None)
+    if cfg is None or not dataclasses.is_dataclass(cfg):
+        return module
+    over: Dict[str, Any] = {}
+    mp = nxd_config.get("mixed_precision_config", {})
+    explicit = nxd_config.get("_explicit_keys", {})
+    for mp_key, field in (("compute_dtype", "dtype"), ("param_dtype", "param_dtype")):
+        if mp_key in explicit.get("mixed_precision_config", ()) and hasattr(cfg, field):
+            over[field] = resolve_dtype(mp[mp_key])
+    ac = nxd_config.get("activation_checkpoint_config")
+    if ac is not None and hasattr(cfg, "remat_policy"):
+        over["remat_policy"] = ac
+    if explicit.get("sequence_parallel") and hasattr(cfg, "sequence_parallel"):
+        over["sequence_parallel"] = True
+    if not over:
+        return module
+    return type(module)(dataclasses.replace(cfg, **over))
 
 
 def initialize_parallel_model(
@@ -67,7 +126,10 @@ def initialize_parallel_model(
     Initializes parallel state from the config if needed, then jits
     ``module.init`` with sharded out_shardings so each param is *born* on its
     mesh shard (replacing reference phases 1+3: meta init + staggered move,
-    trainer.py:151-176, utils/model_utils.py:245,320).
+    trainer.py:151-176, utils/model_utils.py:245,320). Applies
+    mixed-precision / activation-checkpoint config overrides to the model
+    config and injects LoRA adapters when ``lora_config`` is set (reference
+    phases 4+6).
     """
     if not ps.model_parallel_is_initialized():
         ps.initialize_model_parallel(
@@ -76,7 +138,7 @@ def initialize_parallel_model(
             expert_model_parallel_size=nxd_config["expert_parallel_size"],
         )
     mesh = ps.get_mesh()
-    module = module_fn()
+    module = _apply_config_overrides(module_fn(), nxd_config)
     seed = nxd_config.get("model_init_config", {}).get("seed", 0)
     rngs = rngs or {"params": jax.random.key(seed)}
 
@@ -92,4 +154,24 @@ def initialize_parallel_model(
         return meta.unbox(variables)["params"]
 
     params = jax.jit(init_fn, out_shardings=shardings)()
-    return ParallelModel(module=module, params=params, param_specs=specs, mesh=mesh)
+
+    lora_cfg = nxd_config.get("lora_config")
+    lora_params = lora_specs = None
+    if lora_cfg is not None:
+        from neuronx_distributed_tpu.lora.core import (
+            LoraConfig,
+            init_lora,
+            lora_param_specs,
+        )
+
+        if isinstance(lora_cfg, dict):
+            lora_cfg = LoraConfig(**lora_cfg)
+        lora_params = init_lora(params, lora_cfg, jax.random.key(seed + 1))
+        lora_specs = lora_param_specs(lora_params, params, specs)
+        lora_params = jax.device_put(
+            lora_params, specs_to_shardings(lora_specs, mesh)
+        )
+    return ParallelModel(
+        module=module, params=params, param_specs=specs, mesh=mesh,
+        lora_config=lora_cfg, lora_params=lora_params, lora_specs=lora_specs,
+    )

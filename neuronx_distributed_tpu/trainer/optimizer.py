@@ -67,9 +67,12 @@ def initialize_parallel_optimizer(
         else:
             tx = optax.adamw(learning_rate, weight_decay=weight_decay, **adam_kwargs)
     # always a plan: ZeRO augments state specs with DP axes; otherwise state
-    # mirrors the params' own TP/EP shardings (never blindly replicated)
+    # mirrors the params' own TP/EP shardings (never blindly replicated).
+    # With LoRA active the optimizer tracks ONLY the adapter tree (base is
+    # frozen — no state for it, reference requires_grad freeze).
     plan = make_zero1_plan(
-        model.param_specs, model.params, model.mesh, augment=opt_cfg["zero_one_enabled"]
+        model.trainable_specs, model.trainable_params, model.mesh,
+        augment=opt_cfg["zero_one_enabled"],
     )
     return NxDOptimizer(
         tx=tx,

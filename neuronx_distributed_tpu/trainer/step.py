@@ -39,15 +39,18 @@ class TrainState(struct.PyTreeNode):
 
 def create_train_state(model: ParallelModel, optimizer: NxDOptimizer) -> TrainState:
     """Initialize optimizer state sharded per the ZeRO-1 plan (state is born
-    sharded, like params — no scatter after the fact)."""
+    sharded, like params — no scatter after the fact). With LoRA active,
+    ``state.params`` is the ADAPTER tree; the frozen base stays on the model."""
     opt_state = jax.jit(
         optimizer.init, out_shardings=_opt_state_shardings(model, optimizer)
-    )(model.params)
-    return TrainState(step=jnp.zeros((), jnp.int32), params=model.params, opt_state=opt_state)
+    )(model.trainable_params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=model.trainable_params, opt_state=opt_state
+    )
 
 
 def _opt_state_shardings(model: ParallelModel, optimizer: NxDOptimizer):
-    abstract = jax.eval_shape(optimizer.init, model.params)
+    abstract = jax.eval_shape(optimizer.init, model.trainable_params)
     return optimizer.zero1_plan.opt_state_shardings(abstract)
 
 
@@ -66,7 +69,24 @@ def make_train_step(
     equivalence, see parallel/grads.py).
     """
     mesh = model.mesh
-    param_shardings = model.param_shardings()
+    param_shardings = model.trainable_shardings()
+
+    if model.lora_config is not None:
+        # LoRA: state.params is the adapter tree; merge W + scale*A@B inside
+        # the step so loss_fn sees full params, and differentiate w.r.t. the
+        # adapters only — the base (closed over) gets no gradient, no
+        # optimizer state, and cannot drift (reference requires_grad freeze,
+        # modules/lora/model.py:175).
+        inner_loss = loss_fn
+        lora_cfg = model.lora_config
+
+        def loss_fn(lora_tree, batch, rng):  # noqa: F811
+            if lora_cfg.lora_dropout > 0.0:
+                from neuronx_distributed_tpu.lora.core import dropout_adapters
+
+                drop_rng, rng = jax.random.split(rng)
+                lora_tree = dropout_adapters(lora_tree, lora_cfg, drop_rng)
+            return inner_loss(model.merged_params(lora_tree), batch, rng)
 
     def step_fn(state: TrainState, batch: PyTree, rng: jax.Array):
         grad_fn = jax.value_and_grad(loss_fn)

@@ -1,0 +1,179 @@
+"""Shared example-script machinery (reference
+``examples/training/llama/training_utils.py`` — argparse plumbing, synthetic
+data, Throughput/metrics logging — and the checkpoint-resume flow of
+``run_llama_nxd.py:205-237``).
+
+Every training script in this directory follows the same skeleton:
+``neuronx_distributed_config`` → ``initialize_parallel_model`` →
+``initialize_parallel_optimizer`` → ``make_train_step`` → :func:`train_loop`.
+Scripts accept ``--tiny`` so CI can smoke them on the virtual CPU mesh
+(SURVEY §4.2: recreate the reference's single-host multi-rank tier with a
+forced-device-count CPU mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from neuronx_distributed_tpu.checkpoint import (
+    finalize_checkpoint,
+    has_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from neuronx_distributed_tpu.utils import MetricsWriter, Throughput, get_logger
+from neuronx_distributed_tpu.utils.profiler import profile_steps, step_annotation
+
+logger = get_logger("nxd.examples")
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Self-provision a virtual CPU device mesh for ``--tiny`` runs (same
+    pattern as ``__graft_entry__.dryrun_multichip``): this image's
+    sitecustomize pins ``JAX_PLATFORMS`` to the TPU plugin at interpreter
+    start, so the env var alone is too late — switch via jax.config too."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"virtual CPU mesh has {len(jax.devices())} devices (< {n_devices}); "
+            "jax was already initialized on another platform — set "
+            f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            "before python starts"
+        )
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
+    parser.add_argument("--pipeline_parallel_size", "--pp", type=int, default=None)
+    parser.add_argument("--batch_size", type=int, default=None)
+    parser.add_argument("--seq_len", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--warmup_steps", type=int, default=0)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    parser.add_argument("--weight_decay", type=float, default=0.01)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log_every", type=int, default=10)
+    parser.add_argument("--checkpoint_dir", type=str, default=None)
+    parser.add_argument("--checkpoint_every", type=int, default=0,
+                        help="save every N steps (0 = only at end when dir set)")
+    parser.add_argument("--metrics_file", type=str, default=None)
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="jax.profiler XProf trace output dir")
+    parser.add_argument(
+        "--tiny", action="store_true",
+        help="shrink the model/batch to CI scale (virtual CPU mesh smoke)",
+    )
+    return parser
+
+
+def synthetic_lm_batches(vocab_size: int, batch: int, seq: int,
+                         seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Deterministic synthetic next-token batches (the reference examples read
+    tokenized HDF5/arrow shards; data loading is orthogonal to what these
+    scripts exercise, so synthetic keeps them hermetic)."""
+    rs = np.random.RandomState(seed)
+    while True:
+        ids = rs.randint(0, vocab_size, (batch, seq + 1), dtype=np.int64)
+        yield {"ids": ids[:, :-1].astype(np.int32), "labels": ids[:, 1:].astype(np.int32)}
+
+
+def synthetic_mlm_batches(vocab_size: int, batch: int, seq: int, seed: int = 0,
+                          mask_token: int = 103, mask_prob: float = 0.15,
+                          ignore_index: int = -100) -> Iterator[Dict[str, np.ndarray]]:
+    """BERT-style MLM+NSP batches (the reference's HDF5 records carry
+    input_ids / segment_ids / input_mask / masked_lm_labels /
+    next_sentence_labels — same five fields here)."""
+    rs = np.random.RandomState(seed)
+    while True:
+        ids = rs.randint(5, vocab_size, (batch, seq), dtype=np.int64)
+        seg = (np.arange(seq)[None, :] >= rs.randint(1, seq, (batch, 1))).astype(np.int32)
+        mask = np.ones((batch, seq), np.int32)
+        pad_from = rs.randint(seq // 2, seq + 1, (batch,))
+        for i, p in enumerate(pad_from):
+            mask[i, p:] = 0
+        mlm_labels = np.full((batch, seq), ignore_index, np.int64)
+        masked = (rs.rand(batch, seq) < mask_prob) & (mask == 1)
+        mlm_labels[masked] = ids[masked]
+        input_ids = ids.copy()
+        input_ids[masked] = mask_token
+        nsp = rs.randint(0, 2, (batch,), dtype=np.int64)
+        yield {
+            "input_ids": input_ids.astype(np.int32),
+            "token_type_ids": seg,
+            "attention_mask": mask,
+            "masked_lm_labels": mlm_labels.astype(np.int32),
+            "next_sentence_labels": nsp.astype(np.int32),
+        }
+
+
+def train_loop(
+    step_fn: Callable,
+    state,
+    batches: Iterator[Dict[str, np.ndarray]],
+    steps: int,
+    *,
+    batch_size: int,
+    log_every: int = 10,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    metrics_file: Optional[str] = None,
+    profile_dir: Optional[str] = None,
+    seed: int = 0,
+):
+    """Run ``steps`` training steps with throughput logging, optional
+    periodic checkpointing, and optional XProf profiling. Returns
+    ``(final_state, last_metrics_dict)``."""
+    start_step = int(state.step)
+    throughput = Throughput(batch_size)
+    writer = MetricsWriter(metrics_file)
+    metrics = {}
+    last_logged = start_step
+    try:
+        with profile_steps(profile_dir):
+            for i in range(start_step, steps):
+                batch = next(batches)
+                with step_annotation(i):
+                    state, metrics = step_fn(state, batch, jax.random.key(seed + i + 1))
+                if log_every and ((i + 1) % log_every == 0 or i + 1 == steps):
+                    loss = float(metrics["loss"])  # host fetch = step synced
+                    # get_throughput()'s time delta spans the steps since the
+                    # previous log call — scale by exactly that count
+                    seq_s = throughput.get_throughput() * (i + 1 - last_logged)
+                    last_logged = i + 1
+                    logger.info("step %d/%d loss %.4f (%.2f seq/s)", i + 1, steps, loss, seq_s)
+                    writer.log(i + 1, loss=loss, seqs_per_sec=seq_s,
+                               grad_norm=metrics.get("grad_norm", 0.0))
+                if checkpoint_dir and checkpoint_every and (i + 1) % checkpoint_every == 0:
+                    save_checkpoint(checkpoint_dir, f"step_{i + 1}", state,
+                                    user_content={"step": i + 1}, async_save=True,
+                                    num_kept=3)
+        if checkpoint_dir:
+            save_checkpoint(checkpoint_dir, f"step_{steps}", state,
+                            user_content={"step": steps}, num_kept=3)
+    finally:
+        finalize_checkpoint()
+        writer.close()
+    return state, metrics
+
+
+def maybe_resume(checkpoint_dir: Optional[str], state):
+    """Resume from the newest completed tag when one exists (reference
+    ``latest_if_exists``, run_llama_nxd.py:205-237)."""
+    if not checkpoint_dir or not has_checkpoint(checkpoint_dir):
+        return state
+    target = jax.tree.map(lambda x: x, state)
+    restored, content = load_checkpoint(checkpoint_dir, target=target)
+    logger.info("resumed from %s at step %s", checkpoint_dir, (content or {}).get("step"))
+    return restored

@@ -1,0 +1,205 @@
+"""Llama inference runner + latency benchmark (BASELINE config #5).
+
+TPU-native counterpart of the reference's ``examples/inference/runner.py``
+(649 LoC — trace / load-traced / generate / benchmark / check-accuracy) and
+``modules/benchmark.py`` (``LatencyCollector`` percentile report :43-71).
+Subcommands:
+
+* ``generate`` — compile the bucketed KV-cached CausalLM and decode prompts
+  (token ids in, token ids out; pass --hf_checkpoint to serve real weights
+  through the HF converter);
+* ``benchmark`` — p50/p90/p95/p99 TTFT + per-token decode latency +
+  end-to-end throughput per submodel (context-encoding vs token-gen — the
+  reference reports the same split per model wrapper).
+
+Run (13B dims, TP8):
+    python examples/inference/runner.py benchmark --tp 8
+CI smoke:
+    python examples/inference/runner.py benchmark --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference import CausalLM, Sampler
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama2_13b
+from neuronx_distributed_tpu.trainer import (
+    initialize_parallel_model,
+    neuronx_distributed_config,
+)
+from neuronx_distributed_tpu.utils import get_logger
+
+logger = get_logger("nxd.examples.inference")
+
+
+def build_config(args) -> LlamaConfig:
+    if args.tiny:
+        return LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+            num_heads=4, num_kv_heads=4, max_seq_len=256, dtype=jnp.float32,
+            use_flash_attention=False,
+        )
+    return llama2_13b(
+        max_seq_len=args.max_seq_len, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        remat_policy=None, attention_block_q=256, attention_block_k=512,
+    )
+
+
+def build_model(args):
+    cfg = build_config(args)
+    nxd_config = neuronx_distributed_config(
+        tensor_parallel_size=args.tensor_parallel_size or (2 if args.tiny else 8)
+    )
+    ids = jnp.zeros((1, 8), jnp.int32)
+    if args.hf_checkpoint:
+        import dataclasses
+
+        from flax import linen as nn
+
+        from neuronx_distributed_tpu.converters.hf_llama import (
+            config_from_hf,
+            hf_to_nxd_llama,
+            load_hf_safetensors,
+        )
+        from neuronx_distributed_tpu.parallel import mesh as ps
+        from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
+
+        cfg = dataclasses.replace(
+            config_from_hf(args.hf_checkpoint), max_seq_len=args.max_seq_len,
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        )
+        if not ps.model_parallel_is_initialized():
+            ps.initialize_model_parallel(
+                tensor_model_parallel_size=nxd_config["tensor_parallel_size"]
+            )
+        # no throwaway random init: abstract-eval for the sharding specs,
+        # then place the converted HF weights directly
+        module = LlamaForCausalLM(cfg)
+        abstract = jax.eval_shape(lambda: module.init(jax.random.key(0), ids))
+        specs = nn.get_partition_spec(abstract)["params"]
+        params = hf_to_nxd_llama(load_hf_safetensors(args.hf_checkpoint), cfg)
+        params = jax.device_put(params, specs_to_shardings(specs, ps.get_mesh()))
+    else:
+        model = initialize_parallel_model(nxd_config, lambda: LlamaForCausalLM(cfg), ids)
+        params = model.params
+    buckets = (64, 128) if args.tiny else tuple(
+        b for b in (128, 512, 2048, 4096) if b < cfg.max_seq_len
+    )
+    lm = CausalLM(cfg, params, LlamaForCausalLM,
+                  buckets=buckets, max_batch=args.max_batch)
+    return lm, cfg
+
+
+def cmd_generate(args) -> None:
+    lm, cfg = build_model(args)
+    rs = np.random.RandomState(args.seed)
+    b = min(args.max_batch, 2)
+    prompt_len = 16 if args.tiny else 128
+    prompts = rs.randint(1, cfg.vocab_size, (b, prompt_len)).astype(np.int32)
+    t0 = time.perf_counter()
+    lm.compile()
+    logger.info("compiled in %.1fs", time.perf_counter() - t0)
+    result = lm.generate(
+        prompts, max_new_tokens=args.max_new_tokens,
+        sampler=Sampler(greedy=not args.sample, temperature=args.temperature,
+                        top_k=args.top_k or None,
+                        top_p=args.top_p if args.top_p < 1.0 else None),
+        rng=jax.random.key(args.seed),
+    )
+    for i, (toks, n) in enumerate(zip(result.tokens, result.lengths)):
+        print(json.dumps({"prompt": i, "generated": toks[:n].tolist()}))
+
+
+def percentiles(ts) -> dict:
+    """The reference benchmark's latency report (benchmark.py:55-71)."""
+    arr = np.asarray(ts) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "p90_ms": round(float(np.percentile(arr, 90)), 2),
+        "p95_ms": round(float(np.percentile(arr, 95)), 2),
+        "p99_ms": round(float(np.percentile(arr, 99)), 2),
+        "p100_ms": round(float(np.max(arr)), 2),
+    }
+
+
+def cmd_benchmark(args) -> None:
+    lm, cfg = build_model(args)
+    lm.compile()
+    rs = np.random.RandomState(args.seed)
+    prompt_len = 16 if args.tiny else args.prompt_len
+    bucket = lm._bucket_for(prompt_len)
+    prompt = np.zeros((lm.max_batch, bucket), np.int32)
+    prompt[:, :prompt_len] = rs.randint(1, cfg.vocab_size, (lm.max_batch, prompt_len))
+
+    # context encoding (TTFT): prefill + first-token argmax fetched to host
+    ttft = []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        logits, cache = lm._prefill[bucket](lm.params, jnp.asarray(prompt))
+        int(jnp.argmax(logits[0, prompt_len - 1]))  # host fetch = sync
+        ttft.append(time.perf_counter() - t0)
+
+    # token generation: chained decode steps
+    tok = jnp.zeros((lm.max_batch, 1), jnp.int32)
+    logits, cache = lm._decode(lm.params, cache, tok)
+    jax.block_until_ready(logits)
+    decode = []
+    for _ in range(args.decode_steps):
+        t0 = time.perf_counter()
+        logits, cache = lm._decode(lm.params, cache, tok)
+        float(logits[0, 0, 0])
+        decode.append(time.perf_counter() - t0)
+
+    report = {
+        "model": "llama2_13b_dims" if not args.tiny else "tiny",
+        "tp": args.tensor_parallel_size or (2 if args.tiny else 8),
+        "batch": lm.max_batch,
+        "prompt_len": prompt_len,
+        "context_encoding": percentiles(ttft),
+        "token_generation": percentiles(decode),
+        "decode_tokens_per_sec": round(lm.max_batch / float(np.median(decode)), 1),
+    }
+    print(json.dumps(report))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name in ("generate", "benchmark"):
+        p = sub.add_parser(name)
+        p.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
+        p.add_argument("--tiny", action="store_true")
+        p.add_argument("--hf_checkpoint", type=str, default=None)
+        p.add_argument("--max_seq_len", type=int, default=4096)
+        p.add_argument("--max_batch", type=int, default=1)
+        p.add_argument("--max_new_tokens", type=int, default=32)
+        p.add_argument("--prompt_len", type=int, default=2048)
+        p.add_argument("--trials", type=int, default=10)
+        p.add_argument("--decode_steps", type=int, default=50)
+        p.add_argument("--sample", action="store_true",
+                       help="sample with temperature/top_k/top_p (default greedy)")
+        p.add_argument("--temperature", type=float, default=1.0)
+        p.add_argument("--top_k", type=int, default=0)
+        p.add_argument("--top_p", type=float, default=1.0)
+        p.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    if args.tiny:
+        from common import force_cpu_mesh
+
+        force_cpu_mesh()
+    {"generate": cmd_generate, "benchmark": cmd_benchmark}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Llama-2 7B TP+ZeRO-1+SP pretraining (BASELINE config #3).
+
+TPU-native counterpart of the reference's
+``examples/training/llama/tp_zero1_llama_hf_pretrain`` scripts
+(``run_llama_nxd.py`` — TP8, ZeRO-1 sharded AdamW with fp32 masters,
+sequence parallelism, selective activation checkpointing, flash attention).
+
+Run (full scale):
+    python examples/training/llama2_tp_zero1.py --tp 8 --steps 100
+CI smoke:
+    python examples/training/llama2_tp_zero1.py --tiny --steps 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+
+from common import add_common_args, maybe_resume, synthetic_lm_batches, train_loop
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama2_7b
+from neuronx_distributed_tpu.trainer import (
+    create_train_state,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+    neuronx_distributed_config,
+)
+
+
+def build_config(args, seq: int) -> LlamaConfig:
+    if args.tiny:
+        return LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+            num_heads=4, num_kv_heads=4, max_seq_len=seq, dtype=jnp.float32,
+            use_flash_attention=False, remat_policy=None,
+        )
+    # bf16 storage + fp32 masters in the ZeRO-1 optimizer; "attention" remat
+    # is the reference's selective-checkpoint choice (run_llama_nxd.py:113)
+    return llama2_7b(
+        max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        sequence_parallel=True, remat_policy="attention",
+        attention_block_q=256, attention_block_k=512,
+    )
+
+
+def main(argv=None) -> float:
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    args = parser.parse_args(argv)
+    if args.tiny:
+        from common import force_cpu_mesh
+
+        force_cpu_mesh()
+    tp = args.tensor_parallel_size or (2 if args.tiny else 8)
+    batch = args.batch_size or (4 if args.tiny else 8)
+    seq = args.seq_len or (32 if args.tiny else 4096)
+    steps = args.steps or (4 if args.tiny else 100)
+
+    lcfg = build_config(args, seq)
+    nxd_config = neuronx_distributed_config(
+        tensor_parallel_size=tp,
+        sequence_parallel=lcfg.sequence_parallel,
+        optimizer_config={"zero_one_enabled": True, "grad_clipping": True,
+                          "max_grad_norm": 1.0},
+        mixed_precision_config={"use_master_weights": True},
+    )
+    batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
+    sample = next(batches)
+    model = initialize_parallel_model(
+        nxd_config, lambda: LlamaForCausalLM(lcfg), sample["ids"]
+    )
+    opt = initialize_parallel_optimizer(
+        nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay
+    )
+    state = maybe_resume(args.checkpoint_dir, create_train_state(model, opt))
+
+    def loss_fn(params, b, rng):
+        return model.module.apply(
+            {"params": params}, b["ids"], b["labels"], method=LlamaForCausalLM.loss
+        )
+
+    step = make_train_step(model, opt, loss_fn)
+    state, metrics = train_loop(
+        step, state, batches, steps,
+        batch_size=batch, log_every=args.log_every,
+        checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
+        metrics_file=args.metrics_file, profile_dir=args.profile_dir, seed=args.seed,
+    )
+    return float(metrics["loss"])
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,8 @@ def main(argv=None):
     p.add_argument("--direction", choices=["hf2nxd", "nxd2hf"], default="hf2nxd")
     p.add_argument("--config", help="HF config.json (defaults to <input>/config.json)")
     p.add_argument("--fused-qkv", action="store_true")
+    p.add_argument("--tag", default=None,
+                   help="framework checkpoint tag (default: newest completed)")
     args = p.parse_args(argv)
     cfg = config_from_hf(args.config or args.input)
     if args.direction == "hf2nxd":
@@ -217,11 +219,15 @@ def main(argv=None):
                                  fused_qkv=args.fused_qkv)
         from neuronx_distributed_tpu.checkpoint import save_checkpoint
 
-        save_checkpoint(args.output, tag="converted", state=params, async_save=False)
+        save_checkpoint(args.output, tag=args.tag or "converted", state=params,
+                        async_save=False)
     else:
         from neuronx_distributed_tpu.checkpoint import load_checkpoint
 
-        params, _ = load_checkpoint(args.input, tag="converted")
+        state, _ = load_checkpoint(args.input, tag=args.tag)
+        # accept either a bare param tree or a saved TrainState (train_loop
+        # checkpoints) — the params live under "params" there
+        params = state.get("params", state) if isinstance(state, dict) else state.params
         save_hf_safetensors(
             nxd_to_hf_llama(params, cfg, fused_qkv=args.fused_qkv),
             os.path.join(args.output, "model.safetensors"),

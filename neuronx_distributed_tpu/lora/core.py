@@ -50,35 +50,46 @@ def _is_target(path_str: str, cfg: LoraConfig) -> bool:
                for t in cfg.target_modules)
 
 
-def _kernel_2d(shape) -> Optional[Tuple[int, int]]:
-    """LoRA factorization dims: 2D kernels as-is; >=3D kernels (GQA (H,N,D),
-    expert (E,H,I)) flatten trailing dims into 'out'."""
-    if len(shape) < 2:
+# scan-over-layers stacks per-layer kernels on a leading (L, ...) axis (all
+# in-repo model families put them under a "layers" collection); adapters must
+# then be PER LAYER — one global factorization would couple every layer
+# through a single rank-r bottleneck and blow the adapter size up by L
+_STACKED_RE = re.compile(r"\['layers'\]")
+
+
+def _factor_dims(pstr: str, shape) -> Optional[Tuple[int, int, int]]:
+    """LoRA factorization dims ``(stack, fan_in, fan_out)``: ``stack`` is the
+    scan-layer axis size (1 = unstacked); trailing dims (GQA (H,N,D), expert
+    (E,H,I)) flatten into 'out'."""
+    stacked = bool(_STACKED_RE.search(pstr))
+    if len(shape) < 2 + int(stacked):
         return None
-    fan_in = shape[0]
+    body = shape[1:] if stacked else shape
     fan_out = 1
-    for s in shape[1:]:
+    for s in body[1:]:
         fan_out *= s
-    return fan_in, fan_out
+    return (shape[0] if stacked else 0), body[0], fan_out
 
 
 def init_lora(params: PyTree, config: LoraConfig, rng: jax.Array,
               param_specs: Optional[PyTree] = None) -> PyTree:
     """Create the adapter tree, mirroring ``params`` structure but containing
-    only targeted kernels, each as {"lora_a": (in, r), "lora_b": (r, out)}.
-    ``lora_b`` starts at zero so W_eff == W at step 0 (reference
-    inject_adapter init)."""
+    only targeted kernels, each as {"lora_a": (in, r), "lora_b": (r, out)} —
+    with a leading per-layer axis for scan-stacked kernels. ``lora_b`` starts
+    at zero so W_eff == W at step 0 (reference inject_adapter init)."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     adapters = {}
     keys = jax.random.split(rng, max(len(flat), 1))
     for (path, leaf), key in zip(flat, keys):
         pstr = jax.tree_util.keystr(path)
-        dims = _kernel_2d(getattr(leaf, "shape", ()))
+        dims = _factor_dims(pstr, getattr(leaf, "shape", ()))
         if dims is None or not _is_target(pstr, config) or not pstr.endswith("ernel']"):
             continue
-        fan_in, fan_out = dims
-        a = jax.random.normal(key, (fan_in, config.r), jnp.float32) * (1.0 / fan_in**0.5)
-        b = jnp.zeros((config.r, fan_out), jnp.float32)
+        stack, fan_in, fan_out = dims
+        a_shape = (stack, fan_in, config.r) if stack else (fan_in, config.r)
+        b_shape = (stack, config.r, fan_out) if stack else (config.r, fan_out)
+        a = jax.random.normal(key, a_shape, jnp.float32) * (1.0 / fan_in**0.5)
+        b = jnp.zeros(b_shape, jnp.float32)
         adapters[pstr] = {"lora_a": a, "lora_b": b}
     if not adapters:
         raise ValueError(f"no kernels matched target_modules {config.target_modules}")
@@ -86,8 +97,9 @@ def init_lora(params: PyTree, config: LoraConfig, rng: jax.Array,
 
 
 def merge_lora(params: PyTree, lora_params: PyTree, config: LoraConfig) -> PyTree:
-    """W_eff = W + scaling * A @ B, reshaped back to W's shape (reference
-    ``merge_lora``:357 — here the merge is also the forward path)."""
+    """W_eff = W + scaling * A @ B (batched per layer for stacked kernels),
+    reshaped back to W's shape (reference ``merge_lora``:357 — here the merge
+    is also the forward path)."""
 
     def merge_leaf(path, leaf):
         pstr = jax.tree_util.keystr(path)
@@ -112,8 +124,9 @@ def dropout_adapters(lora_params: PyTree, config: LoraConfig, rng: jax.Array) ->
     keep = 1.0 - config.lora_dropout
     out = {}
     for i, (pstr, ad) in enumerate(sorted(lora_params.items())):
+        # per fan-in-feature mask (per layer when stacked): A is (..., in, r)
         mask = jax.random.bernoulli(
-            jax.random.fold_in(rng, i), keep, (ad["lora_a"].shape[0], 1)
+            jax.random.fold_in(rng, i), keep, ad["lora_a"].shape[:-1] + (1,)
         )
         out[pstr] = {"lora_a": ad["lora_a"] * mask / keep, "lora_b": ad["lora_b"]}
     return out
@@ -133,7 +146,14 @@ def lora_param_specs(lora_params: PyTree, params: PyTree,
     for pstr, ad in lora_params.items():
         spec = flat_specs.get(pstr)
         entries = list(spec) if isinstance(spec, P) else []
-        in_axis = entries[0] if entries else None
-        out_axis = entries[1] if len(entries) > 1 else None
-        out[pstr] = {"lora_a": P(in_axis, None), "lora_b": P(None, out_axis)}
+        if ad["lora_a"].ndim == 3:  # stacked: base spec is (stack, in, out...)
+            stack_axis = entries[0] if entries else None
+            in_axis = entries[1] if len(entries) > 1 else None
+            out_axis = entries[2] if len(entries) > 2 else None
+            out[pstr] = {"lora_a": P(stack_axis, in_axis, None),
+                         "lora_b": P(stack_axis, None, out_axis)}
+        else:
+            in_axis = entries[0] if entries else None
+            out_axis = entries[1] if len(entries) > 1 else None
+            out[pstr] = {"lora_a": P(in_axis, None), "lora_b": P(None, out_axis)}
     return out

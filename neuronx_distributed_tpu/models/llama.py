@@ -69,25 +69,29 @@ class LlamaConfig:
 
 
 # presets mirroring the reference's example configs (BASELINE.md ladder)
+def _preset(base, over):
+    return LlamaConfig(**{**base, **over})
+
+
 def llama2_7b(**over) -> LlamaConfig:
-    return LlamaConfig(hidden_size=4096, intermediate_size=11008, num_layers=32,
-                       num_heads=32, num_kv_heads=32, **over)
+    return _preset(dict(hidden_size=4096, intermediate_size=11008, num_layers=32,
+                        num_heads=32, num_kv_heads=32), over)
 
 
 def llama2_13b(**over) -> LlamaConfig:
-    return LlamaConfig(hidden_size=5120, intermediate_size=13824, num_layers=40,
-                       num_heads=40, num_kv_heads=40, **over)
+    return _preset(dict(hidden_size=5120, intermediate_size=13824, num_layers=40,
+                        num_heads=40, num_kv_heads=40), over)
 
 
 def llama2_70b(**over) -> LlamaConfig:
-    return LlamaConfig(hidden_size=8192, intermediate_size=28672, num_layers=80,
-                       num_heads=64, num_kv_heads=8, **over)
+    return _preset(dict(hidden_size=8192, intermediate_size=28672, num_layers=80,
+                        num_heads=64, num_kv_heads=8), over)
 
 
 def llama3_8b(**over) -> LlamaConfig:
-    return LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
-                       num_layers=32, num_heads=32, num_kv_heads=8,
-                       rope_theta=500000.0, **over)
+    return _preset(dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                        num_layers=32, num_heads=32, num_kv_heads=8,
+                        rope_theta=500000.0), over)
 
 
 def rotary_embedding(positions: jax.Array, head_dim: int, theta: float,

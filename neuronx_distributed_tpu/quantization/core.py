@@ -34,9 +34,11 @@ class QuantizationConfig:
     quantized_dtype: Any = jnp.int8
     target_patterns: Tuple[str, ...] = ("kernel",)    # leaf-name match
     exclude_patterns: Tuple[str, ...] = ("embed", "lm_head", "norm", "bias")
-    # 3D leaves matching these have a leading batch dim (experts (E,H,I)):
-    # fan-in is then axis 1, so each expert keeps its own scales
+    # >=3D leaves matching these have a leading batch dim — experts (E,H,I)
+    # or scan-stacked layers (L,...): fan-in is then axis 1, so each
+    # expert/layer keeps its own scales
     expert_patterns: Tuple[str, ...] = ("expert", "moe", "mlp_fused")
+    stacked_patterns: Tuple[str, ...] = (r"\['layers'\]",)
 
 
 def _is_target(pstr: str, cfg: QuantizationConfig) -> bool:
@@ -64,10 +66,16 @@ def quantize_params(params: PyTree, config: Optional[QuantizationConfig] = None)
             # Reduce over the fan-in axis ONLY (reference observer.py:12 is
             # per output channel): a 2D (in, out) kernel reduces axis 0; a 3D
             # GQA kernel (H, N, D) also reduces axis 0 so every (head, dim)
-            # output channel keeps its own scale; a 3D expert kernel (E, H, I)
-            # reduces axis 1 so scales stay per (expert, out channel).
+            # output channel keeps its own scale; expert (E, H, I) and
+            # scan-stacked (L, ...) kernels carry a leading batch axis, so
+            # fan-in shifts to axis 1 (each expert/layer keeps its own scales
+            # — reducing axis 0 there would share one scale ACROSS layers and
+            # store a full fan_in-sized scale tensor).
             fan_in_axis = 0
-            if w.ndim >= 3 and any(re.search(p, pstr) for p in config.expert_patterns):
+            if w.ndim >= 3 and any(
+                re.search(p, pstr)
+                for p in config.expert_patterns + config.stacked_patterns
+            ):
                 fan_in_axis = 1
             absmax = jnp.max(jnp.abs(w), axis=fan_in_axis, keepdims=True)
         elif config.quantization_type == "per_tensor_symmetric":

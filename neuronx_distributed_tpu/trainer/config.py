@@ -48,7 +48,7 @@ def neuronx_distributed_config(
     tensor_parallel_size: int = 1,
     pipeline_parallel_size: int = 1,
     expert_parallel_size: int = 1,
-    sequence_parallel: bool = False,
+    sequence_parallel: Optional[bool] = None,
     pipeline_config: Optional[Dict[str, Any]] = None,
     optimizer_config: Optional[Dict[str, Any]] = None,
     activation_checkpoint_config: Optional[Any] = None,
@@ -73,7 +73,7 @@ def neuronx_distributed_config(
         "tensor_parallel_size": int(tensor_parallel_size),
         "pipeline_parallel_size": int(pipeline_parallel_size),
         "expert_parallel_size": int(expert_parallel_size),
-        "sequence_parallel": bool(sequence_parallel),
+        "sequence_parallel": bool(sequence_parallel),  # None (default) -> False
         "pipeline_config": merged(_PIPELINE_DEFAULTS, pipeline_config, "pipeline_config"),
         "optimizer_config": merged(_OPTIMIZER_DEFAULTS, optimizer_config, "optimizer_config"),
         "mixed_precision_config": merged(
@@ -88,7 +88,9 @@ def neuronx_distributed_config(
         # setting is never a silent no-op (VERDICT r1 "config facade").
         "_explicit_keys": {
             "mixed_precision_config": sorted((mixed_precision_config or {}).keys()),
-            "sequence_parallel": sequence_parallel,
+            # record SET-ness, not the value: an explicit False must override
+            # a model config's sequence_parallel=True just like True does
+            "sequence_parallel": sequence_parallel is not None,
         },
     }
     if cfg["sequence_parallel"] and cfg["tensor_parallel_size"] == 1:

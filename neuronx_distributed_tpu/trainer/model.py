@@ -108,7 +108,7 @@ def _apply_config_overrides(module: nn.Module, nxd_config: Dict[str, Any]) -> nn
     if ac is not None and hasattr(cfg, "remat_policy"):
         over["remat_policy"] = ac
     if explicit.get("sequence_parallel") and hasattr(cfg, "sequence_parallel"):
-        over["sequence_parallel"] = True
+        over["sequence_parallel"] = bool(nxd_config.get("sequence_parallel"))
     if not over:
         return module
     return type(module)(dataclasses.replace(cfg, **over))

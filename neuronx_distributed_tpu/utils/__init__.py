@@ -1,0 +1,28 @@
+"""Shared utilities (reference ``parallel_layers/utils.py`` +
+``utils/{logger,timeline}.py`` — SURVEY §2.1 "Shared utils", "Logger",
+"PP timeline" rows).
+
+Tensor-arena helpers of the reference (``move_all_tensor_to_cpu``,
+``cast_all``) dissolve under JAX (``jax.device_get`` / tree_map of astype);
+what remains real is divide/padding math, logging, metrics, timeline, and
+profiler hooks.
+"""
+
+from neuronx_distributed_tpu.utils.logger import get_log_level, get_logger  # noqa: F401
+from neuronx_distributed_tpu.utils.metrics import MetricsWriter, Throughput  # noqa: F401
+from neuronx_distributed_tpu.utils.profiler import profile_steps, step_annotation  # noqa: F401
+from neuronx_distributed_tpu.utils.timeline import EventScope, Timeline  # noqa: F401
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact division with the reference's divisibility contract
+    (``parallel_layers/utils.py:90``)."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+    return numerator // denominator
+
+
+def pad_to_multiple(value: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= value (reference pad helpers,
+    ``parallel_layers/utils.py`` / ``pad.py`` padding math)."""
+    return ((value + multiple - 1) // multiple) * multiple

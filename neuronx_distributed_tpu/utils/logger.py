@@ -1,0 +1,76 @@
+"""Logging subsystem (reference ``utils/logger.py`` — ``get_logger``:52,
+``get_log_level``:16, ``_rank0_only``:91).
+
+Env control mirrors the reference:
+
+* ``NXD_LOG_LEVEL``: ``off|error|warning|info|debug|trace`` (default
+  ``info``; ``trace`` maps to DEBUG with per-call site info);
+* ``NXD_LOG_HIDE_TIME``: drop timestamps from the format.
+
+Rank filtering: on a multi-host TPU slice the "rank" is the JAX process
+index; by default only process 0 emits (reference rank0-filter), pass
+``rank0_only=False`` for all-process logging. ``rmsg`` lives in
+``parallel/mesh.py`` and tags messages with the mesh coordinates.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, Optional
+
+_LEVELS: Dict[str, int] = {
+    "off": logging.CRITICAL + 10,
+    "error": logging.ERROR,
+    "warning": logging.WARNING,
+    "info": logging.INFO,
+    "debug": logging.DEBUG,
+    "trace": logging.DEBUG - 5,
+}
+
+_configured: Dict[str, logging.Logger] = {}
+
+
+def get_log_level() -> int:
+    """Resolve ``NXD_LOG_LEVEL`` (reference logger.py:16-35)."""
+    name = os.environ.get("NXD_LOG_LEVEL", "info").strip().lower()
+    if name not in _LEVELS:
+        raise ValueError(f"NXD_LOG_LEVEL must be one of {sorted(_LEVELS)}, got {name!r}")
+    return _LEVELS[name]
+
+
+class _Rank0Filter(logging.Filter):
+    """Suppress records on non-zero processes (reference _rank0_only:91).
+
+    The process index is resolved lazily per record so the filter works
+    before and after distributed initialization."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+
+def get_logger(name: str = "nxd", rank0_only: bool = True) -> logging.Logger:
+    """Singleton logger with env-controlled level (reference get_logger:52)."""
+    key = f"{name}:{rank0_only}"
+    if key in _configured:
+        return _configured[key]
+    logger = logging.getLogger(name if rank0_only else f"{name}.allranks")
+    logger.setLevel(get_log_level())
+    logger.propagate = False
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        fmt = "%(name)s [%(levelname)s] %(message)s"
+        if not os.environ.get("NXD_LOG_HIDE_TIME"):
+            fmt = "%(asctime)s " + fmt
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+    if rank0_only:
+        logger.addFilter(_Rank0Filter())
+    _configured[key] = logger
+    return logger

@@ -1,0 +1,66 @@
+"""Training metrics (reference ``examples/training/llama/training_utils.py``
+— ``Throughput`` moving average :329-351 and the ``TrainingMetrics`` JSON
+writer — plus the per-step metric emission SURVEY §5.1 calls for).
+
+``Throughput`` reports seqs/s over a moving window with the reference's
+definition ``batch×world×accum/Δt``; ``MetricsWriter`` appends one JSON
+object per record (atomic rename on finalize is unnecessary — records are
+line-delimited and self-describing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+
+class Throughput:
+    """Moving-average sequences/sec (reference training_utils.py:329-351)."""
+
+    def __init__(self, batch_size: int, world_size: int = 1,
+                 grad_accum_steps: int = 1, window: int = 10):
+        self.seqs_per_step = batch_size * world_size * grad_accum_steps
+        self.times: deque = deque(maxlen=window)
+        self.last = time.perf_counter()
+
+    def get_throughput(self) -> float:
+        now = time.perf_counter()
+        self.times.append(now - self.last)
+        self.last = now
+        return self.seqs_per_step * len(self.times) / sum(self.times)
+
+
+class MetricsWriter:
+    """Line-delimited JSON metrics file, written by process 0 only."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._fh = None
+        if path:
+            try:
+                import jax
+
+                if jax.process_index() != 0:
+                    self.path = None
+            except Exception:
+                pass
+        if self.path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            self._fh = open(self.path, "a")
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if self._fh is None:
+            return
+        rec: Dict[str, Any] = {"step": step, "time": time.time()}
+        for k, v in metrics.items():
+            rec[k] = float(v) if hasattr(v, "__float__") else v
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

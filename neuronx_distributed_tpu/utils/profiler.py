@@ -1,0 +1,38 @@
+"""Device profiling hooks (reference ``runner.py:106-120`` ``torch_profile``
+context + SURVEY §5.1's "jax.profiler/XProf traces" requirement).
+
+``profile_steps`` wraps a window of training/serving steps in a
+``jax.profiler`` trace (XProf format, viewable in TensorBoard or
+xprof.withgoogle.com); ``StepAnnotation`` marks step boundaries so XProf's
+step-time analysis segments the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_steps(logdir: Optional[str]) -> Iterator[None]:
+    """Trace everything inside the block to ``logdir`` (no-op when None —
+    callers gate profiling on a --profile_dir flag, like the reference's
+    --torch_profile)."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(step: int):
+    """XProf step marker: ``with step_annotation(i): state = train_step(...)``.
+
+    Uses ``StepTraceAnnotation`` so XProf's per-step breakdown works; a plain
+    TraceAnnotation would show the activity but not segment steps."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)

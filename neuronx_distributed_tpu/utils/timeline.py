@@ -1,0 +1,100 @@
+"""Chrome-trace event timeline (reference ``utils/timeline.py`` ``Timeline``
+:14-137 and ``pipeline/timeline.py`` ``PPTimeline``:10-22).
+
+Label-based begin/end events dumped as a Chrome ``trace_event`` JSON array
+(load in ``chrome://tracing`` / Perfetto). The reference gathers per-PP-rank
+events to rank 0 over a gloo group; under single-controller JAX every process
+sees the same program, so each process writes its own file tagged with its
+process index — no gather channel needed.
+
+For device-side timing use :mod:`neuronx_distributed_tpu.utils.profiler`
+(XProf); this timeline covers host-side phases (data loading, checkpoint
+saves, pipeline task issue order).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class Timeline:
+    """begin/end label events (reference Timeline.mark_event_start/end:43,51)."""
+
+    def __init__(self, trace_file_path: Optional[str], rank: Optional[int] = None):
+        self.enabled = trace_file_path is not None
+        self.path = trace_file_path
+        if rank is None:
+            try:
+                import jax
+
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        self.rank = rank
+        self._events: List[Dict] = []
+        self._t0 = time.perf_counter()
+        self._step = 0
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def mark_event_start(self, label: str) -> None:
+        if self.enabled:
+            self._events.append(
+                {"name": label, "ph": "B", "ts": self._now_us(),
+                 "pid": self.rank, "tid": 0}
+            )
+
+    def mark_event_end(self, label: str) -> None:
+        if self.enabled:
+            self._events.append(
+                {"name": label, "ph": "E", "ts": self._now_us(),
+                 "pid": self.rank, "tid": 0}
+            )
+
+    def mark_step_end(self) -> None:
+        """Instant marker between steps (reference mark_step_end:59) +
+        periodic flush so a crash loses at most one step of events."""
+        if not self.enabled:
+            return
+        self._events.append(
+            {"name": f"step_{self._step}", "ph": "i", "ts": self._now_us(),
+             "pid": self.rank, "tid": 0, "s": "g"}
+        )
+        self._step += 1
+        self._dump_events()
+
+    def _dump_events(self) -> None:
+        if not self.enabled:
+            return
+        path = f"{self.path}.rank{self.rank}.json" if self.rank else f"{self.path}.json"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": self._events}, fh)
+
+    def __enter__(self) -> "Timeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._dump_events()
+
+
+class EventScope:
+    """``with timeline.scope("fwd_mb3"):`` convenience."""
+
+    def __init__(self, timeline: Timeline, label: str):
+        self.timeline = timeline
+        self.label = label
+
+    def __enter__(self):
+        self.timeline.mark_event_start(self.label)
+
+    def __exit__(self, *exc):
+        self.timeline.mark_event_end(self.label)
+
+
+def scope(timeline: Timeline, label: str) -> EventScope:
+    return EventScope(timeline, label)

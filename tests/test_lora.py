@@ -159,3 +159,50 @@ def test_config_overrides_applied():
         cfg2, lambda: LlamaForCausalLM(_tiny_cfg(dtype=jnp.float32)), ids
     )
     assert model2.module.config.dtype == jnp.float32
+
+
+def test_stacked_kernels_get_per_layer_adapters():
+    """Scan-stacked kernels (L, in, ...) must factorize PER LAYER — a global
+    factorization over the flattened (in*..., out) would couple layers through
+    one rank-r bottleneck and inflate adapter size ~L x (r1 review fix)."""
+    cfg = _tiny_cfg()
+    ids, _ = _data()
+    model = LlamaForCausalLM(cfg)
+    from flax.core import meta
+
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), ids))["params"]
+    lcfg = LoraConfig(r=4, target_modules=("o_proj",))
+    adapters = init_lora(params, lcfg, jax.random.key(0))
+    (pstr, ad), = adapters.items()
+    L, H = cfg.num_layers, cfg.hidden_size
+    assert ad["lora_a"].shape == (L, H, 4)
+    assert ad["lora_b"].shape == (L, 4, H)
+    # merged delta is per-layer: perturb layer-0 adapter only, layer 1 frozen
+    ad2 = {pstr: {"lora_a": ad["lora_a"].at[0].add(1.0), "lora_b": ad["lora_b"] + 0.5}}
+    merged = merge_lora(params, ad2, lcfg)
+    base_k = params["model"]["layers"]["block"]["attention"]["o_proj"]["kernel"]
+    merged_k = merged["model"]["layers"]["block"]["attention"]["o_proj"]["kernel"]
+    d0 = np.abs(np.asarray(merged_k - base_k))[0].mean()
+    d1 = np.abs(np.asarray(merged_k - base_k))[1].mean()
+    assert d0 > d1 > 0  # both layers get their own delta; layer 0's is larger
+
+
+def test_stacked_adapter_specs_follow_base_sharding():
+    cfg = _tiny_cfg()
+    ids, _ = _data()
+    model = LlamaForCausalLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    from flax import linen as nn
+    from flax.core import meta
+    from jax.sharding import PartitionSpec as P
+
+    params = meta.unbox(variables)["params"]
+    specs = nn.get_partition_spec(variables)["params"]
+    lcfg = LoraConfig(r=4, target_modules=("gate_proj",))
+    adapters = init_lora(params, lcfg, jax.random.key(0))
+    sp = lora_param_specs(adapters, params, specs)
+    (ad_spec,) = sp.values()
+    # base stacked ColumnParallel kernel spec is (None, None, "tp"):
+    # A keeps (stack, in) axes, B carries the tp-sharded out axis
+    assert ad_spec["lora_a"] == P(None, None, None)
+    assert ad_spec["lora_b"] == P(None, None, "tp")

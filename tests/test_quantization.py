@@ -104,3 +104,34 @@ def test_per_tensor_mode():
     params = {"proj": {"kernel": jnp.asarray(np.random.RandomState(1).randn(8, 8), jnp.float32)}}
     q = quantize_params(params, QuantizationConfig(quantization_type="per_tensor_symmetric"))
     assert q["proj"]["kernel"]["scale"].shape == ()
+
+
+def test_stacked_kernel_scales_are_per_layer():
+    """Scan-stacked kernels (L, ...) must keep fan-in at axis 1: reducing the
+    layer axis would share one scale across layers and store a fan_in-sized
+    scale tensor (r1 review fix)."""
+    import re
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.quantization.core import (
+        QuantizationConfig,
+        quantize_params,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=32,
+                      use_flash_attention=False, remat_policy=None)
+    model = LlamaForCausalLM(cfg)
+    from flax.core import meta
+
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), ids))["params"]
+    qp = quantize_params(params, QuantizationConfig())
+    blk = qp["model"]["layers"]["block"]
+    # stacked 3D mlp kernel (L, in, out) -> scale (L, 1, out)
+    gate = blk["mlp"]["gate_proj"]["kernel"]
+    assert gate["qweight"].shape == (2, 32, 64)
+    assert gate["scale"].shape == (2, 1, 64)
+    # stacked 4D GQA kernel (L, in, n, d) -> scale (L, 1, n, d)
+    qk = blk["attention"]["qkv"]["q_kernel"]
+    assert qk["scale"].shape == (2, 1, 4, 8)

@@ -52,6 +52,8 @@ def build_config(args, seq: int) -> LlamaConfig:
 def main(argv=None) -> float:
     parser = add_common_args(argparse.ArgumentParser(description=__doc__))
     parser.add_argument("--num_microbatches", type=int, default=None)
+    parser.add_argument("--num_chunks", type=int, default=1,
+                        help="virtual-pipeline (interleaved) chunks per stage")
     args = parser.parse_args(argv)
     if args.tiny:
         from common import force_cpu_mesh
@@ -78,7 +80,8 @@ def main(argv=None) -> float:
         )
     batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
     sample = next(batches)
-    pmodel = PipelinedLlama(lcfg, num_stages=pp, num_microbatches=num_mb)
+    pmodel = PipelinedLlama(lcfg, num_stages=pp, num_microbatches=num_mb,
+                            num_chunks=args.num_chunks)
     model = pmodel.as_parallel_model(jnp.asarray(sample["ids"]), seed=args.seed)
     opt = initialize_parallel_optimizer(
         nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay

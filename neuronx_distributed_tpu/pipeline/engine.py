@@ -22,8 +22,29 @@ local stage (a ``lax.scan`` over its layer slice) and rotates activations to
 the next stage with ``ppermute``. Bubble fraction is ``(pp-1)/T`` — identical
 to 1F1B's; the backward pipeline emerges from differentiating the scan (the
 reverse program replays ticks backwards, cotangents ppermute the other way).
-Per-tick ``jax.checkpoint`` keeps live memory at one stage-activation per
-in-flight microbatch, the 1F1B memory profile.
+
+Memory profile (honest statement, backed by ``tests/test_pipeline.py``'s
+compiled-memory assertions): with per-tick ``jax.checkpoint``, the forward
+stores ONE stage-input activation per tick — ``T`` microbatch-activations
+per rank, i.e. ~one full-batch activation per stage plus a ``(pp-1)/mb``
+fraction. True 1F1B bounds live activations at ``pp - rank`` microbatches by
+interleaving backward into the forward timeline; a single autodiff'd XLA
+program cannot start backward before forward completes, so that bound is not
+reachable here — the scan profile is the GPipe+remat one. What v2 fixes is
+the part that actually dominated: :func:`pipeline_scalars` computes the loss
+per microbatch ON the last stage as each microbatch drains, so full-batch
+(B, S, vocab) logits are never materialized and only fp32 scalars cross the
+pp boundary (reference computes loss per microbatch on the last stage too,
+``pipeline/model.py:974-1067``, ``_process_loss``:1611).
+
+:func:`pipeline_interleaved` executes the interleaved/VPP schedule
+(``schedules.interleaved_schedule`` task order): stacked params are laid out
+per (stage, chunk) — ``vpp_layer_order`` — and each tick selects the active
+chunk's layer slice; microbatch groups of ``pp`` traverse all ``chunks``
+virtual stages before the next group enters (entry time
+``e_m = (m//pp)*chunks*pp + m%pp``; unit ``(m, c)`` runs on rank ``r`` at
+tick ``e_m + c*pp + r`` — collision-free, gap-free, and every hop is exactly
+one tick, so one ppermute ring buffer carries all chunk traffic).
 """
 
 from __future__ import annotations
@@ -114,32 +135,251 @@ def pipeline(
         reduced = lax.psum(out_buf.astype(jnp.float32) * mask, PP_AXIS)
         return reduced.astype(out_buf.dtype)
 
-    param_specs = lambda tree: jax.tree.map(lambda _: P(PP_AXIS), tree)  # noqa: E731
-
     def apply(stacked_params, x_mb, *broadcast_args):
-        # pp-replicated float inputs cross the shard_map boundary in fp32:
-        # their cotangents are psum'd over pp by the shard_map transpose, and
-        # XLA:CPU's AllReducePromotion crashes on bf16 all-reduce. Cast back
-        # to the compute dtype inside (free on TPU, fused into first use).
-        dtypes = [x_mb.dtype] + [getattr(a, "dtype", None) for a in broadcast_args]
+        return _pp_boundary(inner, mesh, stacked_params, x_mb, *broadcast_args)
 
-        def widen(a):
-            return a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype == jnp.bfloat16 else a
+    return apply
 
-        def boundary_inner(stacked_params, x_mb32, *bargs32):
-            x = x_mb32.astype(dtypes[0])
-            bargs = tuple(
-                a.astype(d) if d is not None else a for a, d in zip(bargs32, dtypes[1:])
+
+def _pp_param_specs(tree):
+    return jax.tree.map(lambda _: P(PP_AXIS), tree)
+
+
+def _widen_bf16(a):
+    return a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype == jnp.bfloat16 else a
+
+
+def _pp_boundary(inner, mesh, stacked_params, *args):
+    """Run ``inner(stacked_params, *args)`` under partial-manual ``shard_map``
+    over pp (TP/SP/DP stay GSPMD-auto inside). The single place that owns the
+    boundary discipline: stacked params get ``P("pp")`` on their leading
+    axis, everything else is pp-replicated, and bf16 float leaves cross the
+    boundary widened to fp32 — their cotangents are psum'd over pp by the
+    shard_map transpose and XLA:CPU's AllReducePromotion pass crashes on bf16
+    all-reduce — then cast back inside (free on TPU, fused into first use).
+    """
+    dtype_trees = [
+        jax.tree.map(lambda a: a.dtype if hasattr(a, "dtype") else None, arg)
+        for arg in args
+    ]
+
+    def boundary(stacked_params, *wargs):
+        restored = tuple(
+            jax.tree.map(lambda a, d: a.astype(d) if d is not None else a, w, dt)
+            for w, dt in zip(wargs, dtype_trees)
+        )
+        return inner(stacked_params, *restored)
+
+    return jax.shard_map(
+        boundary,
+        mesh=mesh,
+        in_specs=(_pp_param_specs(stacked_params), *([P()] * len(args))),
+        out_specs=P(),
+        axis_names={PP_AXIS},
+        check_vma=False,
+    )(stacked_params, *[jax.tree.map(_widen_bf16, a) for a in args])
+
+
+def pipeline_scalars(
+    stage_fn: Callable[..., jax.Array],
+    last_fn: Callable[..., PyTree],
+    num_stages: int,
+    num_microbatches: int,
+    remat: bool = True,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable[..., PyTree]:
+    """Pipeline whose result is a pytree of fp32 SCALARS accumulated on the
+    last stage — the training-loss path.
+
+    ``last_fn(last_params, y, aux_t, valid) -> scalar pytree`` runs every
+    tick on every rank; it must mask itself to zero when ``valid`` (a traced
+    bool) is False. On the tick where microbatch ``m`` drains from the last
+    stage, ``aux_t`` is ``tree_map(lambda a: a[m], aux_mb)`` (labels etc.).
+    Contributions are summed over ticks and ``psum``-ed over pp — no
+    activation or logits tensor is ever replicated across pp (v1 psum'd the
+    full hidden-state buffer; the reference likewise computes loss only on
+    the last stage, pipeline/model.py:974-1067).
+
+    Returns ``apply(stacked_params, last_params, x_mb, aux_mb,
+    *broadcast_args) -> scalar pytree``.
+    """
+    mesh = mesh or ps.get_mesh()
+    pp_size = mesh.shape[PP_AXIS]
+    if num_stages != pp_size:
+        raise ValueError(
+            f"num_stages ({num_stages}) must equal the mesh's pp axis size ({pp_size})"
+        )
+    step = jax.checkpoint(stage_fn) if remat else stage_fn
+    # checkpoint the head+loss too: without it every tick stores its
+    # (b_mb, s, vocab) softmax residuals — the very buffer this path removes
+    last_step = jax.checkpoint(last_fn) if remat else last_fn
+
+    def inner(stacked_params, last_params, x_mb, aux_mb, *broadcast_args):
+        rank = lax.axis_index(PP_AXIS)
+        ticks = num_microbatches + num_stages - 1
+        buf0 = jnp.zeros_like(x_mb[0])
+        aux0 = jax.tree.map(lambda a: a[0], aux_mb)
+        acc0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, jnp.float32),
+            jax.eval_shape(last_fn, last_params, buf0, aux0, jnp.bool_(True)),
+        )
+
+        def tick(carry, t):
+            buf, acc = carry
+            feed_idx = jnp.clip(t, 0, num_microbatches - 1)
+            fresh = lax.dynamic_index_in_dim(x_mb, feed_idx, axis=0, keepdims=False)
+            x_in = jnp.where(rank == 0, fresh, buf)
+            y = step(stacked_params, x_in, *broadcast_args)
+            out_idx = jnp.clip(t - (num_stages - 1), 0, num_microbatches - 1)
+            valid = (t >= num_stages - 1) & (rank == num_stages - 1)
+            aux_t = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, out_idx, axis=0, keepdims=False),
+                aux_mb,
             )
-            return inner(stacked_params, x, *bargs)
+            contrib = last_step(last_params, y, aux_t, valid)
+            acc = jax.tree.map(lambda a, c: a + c.astype(jnp.float32), acc, contrib)
+            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+            buf_next = lax.ppermute(y, PP_AXIS, perm)
+            return (buf_next, acc), None
 
-        return jax.shard_map(
-            boundary_inner,
-            mesh=mesh,
-            in_specs=(param_specs(stacked_params), P(), *([P()] * len(broadcast_args))),
-            out_specs=P(),
-            axis_names={PP_AXIS},
-            check_vma=False,
-        )(stacked_params, widen(x_mb), *[widen(a) for a in broadcast_args])
+        (_, acc), _ = lax.scan(tick, (buf0, acc0), jnp.arange(ticks))
+        # non-last ranks contributed zeros (last_fn masks on valid)
+        return jax.tree.map(lambda a: lax.psum(a, PP_AXIS), acc)
+
+    def apply(stacked_params, last_params, x_mb, aux_mb, *broadcast_args):
+        return _pp_boundary(inner, mesh, stacked_params, last_params, x_mb,
+                            aux_mb, *broadcast_args)
+
+    return apply
+
+
+def vpp_layer_order(num_layers: int, num_stages: int, num_chunks: int):
+    """Permutation mapping canonical layer order to the VPP parameter layout.
+
+    Virtual stage ``v = c*pp + r`` owns canonical layers
+    ``[v*Lc, (v+1)*Lc)``; rank ``r``'s contiguous pp-shard must hold its
+    chunks ``{c*pp + r}`` back to back, so VPP position
+    ``r*(chunks*Lc) + c*Lc + i`` holds canonical layer ``(c*pp + r)*Lc + i``.
+    Apply as ``stacked[order]``; invert with ``jnp.argsort(order)`` (the
+    reference reaches the same layout via per-rank model-chunk lists,
+    pipeline/model.py:832-845).
+    """
+    if num_layers % (num_stages * num_chunks) != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by stages*chunks "
+            f"({num_stages}*{num_chunks})"
+        )
+    lc = num_layers // (num_stages * num_chunks)
+    order = []
+    for r in range(num_stages):
+        for c in range(num_chunks):
+            v = c * num_stages + r
+            order.extend(range(v * lc, (v + 1) * lc))
+    return jnp.asarray(order, jnp.int32)
+
+
+def pipeline_interleaved(
+    stage_fn: Callable[..., jax.Array],
+    num_stages: int,
+    num_chunks: int,
+    num_microbatches: int,
+    last_fn: Optional[Callable[..., PyTree]] = None,
+    remat: bool = True,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable[..., Any]:
+    """Interleaved / virtual-pipeline engine (reference
+    ``TrainInterleavedSchedule``, scheduler.py:256 — here executed, not just
+    generated; task order matches ``schedules.interleaved_schedule``).
+
+    Stacked params must be in the VPP layout (``vpp_layer_order``); each
+    rank's pp-shard is ``(chunks * Lc, ...)`` and the active chunk's
+    ``Lc``-slice is selected per tick. Microbatches advance one virtual
+    stage per tick, so the single ppermute ring carries both rank→rank+1
+    (same chunk) and rank ``pp-1``→0 (next chunk) hops. Bubble spans
+    ``2*(pp-1)`` ticks of ``L/(chunks*pp)`` layers vs the plain engine's
+    ``(pp-1)`` ticks of ``L/pp`` — a ``2/chunks`` reduction, the VPP
+    motivation.
+
+    With ``last_fn`` (signature as :func:`pipeline_scalars`) returns the
+    scalar pytree; otherwise returns the last virtual stage's ``(mb, ...)``
+    outputs replicated over pp.
+    """
+    mesh = mesh or ps.get_mesh()
+    pp_size = mesh.shape[PP_AXIS]
+    if num_stages != pp_size:
+        raise ValueError(
+            f"num_stages ({num_stages}) must equal the mesh's pp axis size ({pp_size})"
+        )
+    if num_microbatches % num_stages != 0:
+        raise ValueError(
+            f"interleaved engine requires num_microbatches ({num_microbatches}) "
+            f"divisible by pp ({num_stages}) — microbatches enter in pp-groups"
+        )
+    S, C = num_stages, num_chunks
+    V = S * C
+    groups = num_microbatches // S
+    ticks = (groups - 1) * V + (S - 1) + V  # last entry + its V-stage traversal
+
+    step = jax.checkpoint(stage_fn) if remat else stage_fn
+    last_step = (jax.checkpoint(last_fn) if remat else last_fn) if last_fn else None
+
+    def unit_at(t, rank):
+        """(chunk, microbatch, valid) scheduled on ``rank`` at tick ``t``."""
+        u = t - rank
+        c = jnp.mod(u, V) // S
+        e = u - c * S                       # entry time of the microbatch
+        m = (e // V) * S + jnp.mod(e, V)    # e mod V is in [0, S) when valid
+        valid = (u >= 0) & (e >= 0) & (m < num_microbatches)
+        return c, jnp.clip(m, 0, num_microbatches - 1), valid
+
+    def inner(stacked_params, last_params, x_mb, aux_mb, *broadcast_args):
+        rank = lax.axis_index(PP_AXIS)
+        lc = jax.tree.leaves(stacked_params)[0].shape[0] // C
+        buf0 = jnp.zeros_like(x_mb[0])
+        if last_fn is not None:
+            aux0 = jax.tree.map(lambda a: a[0], aux_mb)
+            acc0 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, jnp.float32),
+                jax.eval_shape(last_fn, last_params, buf0, aux0, jnp.bool_(True)),
+            )
+        else:
+            acc0 = jnp.zeros_like(jnp.broadcast_to(buf0, (num_microbatches, *buf0.shape)))
+
+        def tick(carry, t):
+            buf, acc = carry
+            c, m, valid = unit_at(t, rank)
+            chunk_params = jax.tree.map(
+                lambda p: lax.dynamic_slice_in_dim(p, c * lc, lc, axis=0),
+                stacked_params,
+            )
+            fresh = lax.dynamic_index_in_dim(x_mb, m, axis=0, keepdims=False)
+            x_in = jnp.where((rank == 0) & (c == 0), fresh, buf)
+            y = step(chunk_params, x_in, *broadcast_args)
+            last_unit = valid & (rank == S - 1) & (c == C - 1)
+            if last_fn is not None:
+                aux_t = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, m, axis=0, keepdims=False),
+                    aux_mb,
+                )
+                contrib = last_step(last_params, y, aux_t, last_unit)
+                acc = jax.tree.map(lambda a, k: a + k.astype(jnp.float32), acc, contrib)
+            else:
+                y_rec = jnp.where(last_unit, y, lax.dynamic_index_in_dim(
+                    acc, m, axis=0, keepdims=False))
+                acc = lax.dynamic_update_index_in_dim(acc, y_rec, m, axis=0)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            buf_next = lax.ppermute(y, PP_AXIS, perm)
+            return (buf_next, acc), None
+
+        (_, acc), _ = lax.scan(tick, (buf0, acc0), jnp.arange(ticks))
+        if last_fn is not None:
+            return jax.tree.map(lambda a: lax.psum(a, PP_AXIS), acc)
+        mask = (rank == S - 1).astype(jnp.float32)
+        reduced = lax.psum(acc.astype(jnp.float32) * mask, PP_AXIS)
+        return reduced.astype(acc.dtype)
+
+    def apply(stacked_params, last_params, x_mb, aux_mb, *broadcast_args):
+        return _pp_boundary(inner, mesh, stacked_params, last_params, x_mb,
+                            aux_mb, *broadcast_args)
 
     return apply

@@ -1,0 +1,98 @@
+"""Mixtral 8x7B MoE pretraining with expert parallelism.
+
+TPU-native counterpart of the reference's ``examples/training/mixtral``
+(TP x EP x DP, top-2 routing, capacity-factor dispatch, load-balancing aux
+loss added to the CE loss, EP-aware ZeRO-1).
+
+Run (full scale):
+    python examples/training/mixtral_moe.py --tp 4 --ep 2 --steps 100
+CI smoke:
+    python examples/training/mixtral_moe.py --tiny --steps 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+
+from common import add_common_args, maybe_resume, synthetic_lm_batches, train_loop
+from neuronx_distributed_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    mixtral_8x7b,
+    mixtral_loss,
+)
+from neuronx_distributed_tpu.trainer import (
+    create_train_state,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+    neuronx_distributed_config,
+)
+
+
+def build_config(args, seq: int) -> MixtralConfig:
+    if args.tiny:
+        return MixtralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+            num_heads=4, num_kv_heads=2, kv_size_multiplier=2, max_seq_len=seq,
+            dtype=jnp.float32, use_flash_attention=False, remat_policy=None,
+            num_experts=4, top_k=2, capacity_factor=2.0,
+        )
+    return mixtral_8x7b(
+        max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        remat_policy="attention", attention_block_q=256, attention_block_k=512,
+    )
+
+
+def main(argv=None) -> float:
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--expert_parallel_size", "--ep", type=int, default=None)
+    args = parser.parse_args(argv)
+    if args.tiny:
+        from common import force_cpu_mesh
+
+        force_cpu_mesh()
+    tp = args.tensor_parallel_size or (2 if args.tiny else 4)
+    ep = args.expert_parallel_size or 2
+    batch = args.batch_size or (4 if args.tiny else 8)
+    seq = args.seq_len or (32 if args.tiny else 4096)
+    steps = args.steps or (3 if args.tiny else 100)
+
+    mcfg = build_config(args, seq)
+    nxd_config = neuronx_distributed_config(
+        tensor_parallel_size=tp,
+        expert_parallel_size=ep,
+        optimizer_config={"zero_one_enabled": True},
+        mixed_precision_config={"use_master_weights": True},
+    )
+    batches = synthetic_lm_batches(mcfg.vocab_size, batch, seq, seed=args.seed)
+    sample = next(batches)
+    model = initialize_parallel_model(
+        nxd_config, lambda: MixtralForCausalLM(mcfg), sample["ids"]
+    )
+    opt = initialize_parallel_optimizer(
+        nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay
+    )
+    state = maybe_resume(args.checkpoint_dir, create_train_state(model, opt))
+
+    def loss_fn(params, b, rng):
+        return mixtral_loss(model.module, params, b["ids"], b["labels"])
+
+    step = make_train_step(model, opt, loss_fn)
+    state, metrics = train_loop(
+        step, state, batches, steps,
+        batch_size=batch, log_every=args.log_every,
+        checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
+        metrics_file=args.metrics_file, profile_dir=args.profile_dir, seed=args.seed,
+    )
+    return float(metrics["loss"])
+
+
+if __name__ == "__main__":
+    main()

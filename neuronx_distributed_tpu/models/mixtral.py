@@ -1,0 +1,163 @@
+"""Mixtral model family: Llama-architecture attention + MoE FFN.
+
+Capability-parity with the reference's Mixtral support
+(``examples/training/mixtral`` training preset and the
+``examples/inference/mixtral`` serving stack over ``modules/moe``): same
+GQA attention as Llama (reused directly — the reference subclasses its Llama
+attention too), each decoder layer's MLP replaced by the MoE block with
+top-k routing, load-balancing aux loss summed into the training loss, and
+token-generation inference dispatching to selective expert loading
+(``moe/expert_mlps.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.models.llama import (
+    LlamaAttention,
+    LlamaConfig,
+    _remat_policy,
+    rotary_embedding,
+)
+from neuronx_distributed_tpu.moe.layer import MoE, collect_aux_losses
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RMSNorm,
+)
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy_mean
+from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, ACT_SP, constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    moe_mode: str = "capacity_factor"  # training/ctx: "capacity_factor" | "all_experts"
+    capacity_factor: float = 1.25
+    router: str = "top_k"
+    aux_loss_coef: float = 0.01
+    z_loss_coef: float = 0.0
+    selective_loading_threshold: float = 0.5
+
+
+def mixtral_8x7b(**over) -> MixtralConfig:
+    return MixtralConfig(**{**dict(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,
+        num_experts=8, top_k=2,
+    ), **over})
+
+
+class MixtralDecoderLayer(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, rope) -> jax.Array:
+        cfg = self.config
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
+        x = x + LlamaAttention(cfg, name="attention")(h, rope)
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
+        moe_out = MoE(
+            num_experts=cfg.num_experts,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            top_k=cfg.top_k,
+            router=cfg.router,
+            mode=cfg.moe_mode,
+            capacity_factor=cfg.capacity_factor,
+            sequence_parallel=cfg.sequence_parallel,
+            aux_loss_coef=cfg.aux_loss_coef,
+            z_loss_coef=cfg.z_loss_coef,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            inference=cfg.decode,
+            selective_loading_threshold=cfg.selective_loading_threshold,
+            name="moe",
+        )(h)
+        return x + moe_out
+
+
+class _MixtralLayerStep(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, rope):
+        cls = MixtralDecoderLayer
+        policy = _remat_policy(self.config.remat_policy)
+        if policy is not None:
+            cls = nn.remat(cls, policy=policy, prevent_cse=False)
+        return cls(self.config, name="block")(x, rope), None
+
+
+class MixtralModel(nn.Module):
+    config: MixtralConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed = ParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, shard_over="vocab",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        self.layers = nn.scan(
+            _MixtralLayerStep,
+            variable_axes={"params": 0, "cache": 0, "losses": 0},
+            split_rngs={"params": True},
+            length=cfg.num_layers,
+            in_axes=nn.broadcast,
+            metadata_params={nn.meta.PARTITION_NAME: None},
+        )(cfg)
+        self.final_norm = RMSNorm(
+            epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel,
+        )
+
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        if input_ids.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        x = self.embed(input_ids)
+        positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
+        x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
+        x, _ = self.layers(x, rope)
+        return self.final_norm(x)
+
+
+class MixtralForCausalLM(nn.Module):
+    """Model + vocab-parallel LM head. The aux (load-balancing) losses are
+    sown into the ``"losses"`` collection per layer; use :func:`mixtral_loss`
+    to train with them included."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = MixtralModel(cfg, name="model")(input_ids)
+        if cfg.sequence_parallel:
+            x = constrain(x, ACT_FULL)
+        return ColumnParallelLinear(
+            cfg.vocab_size, use_bias=False, gather_output=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+
+
+def mixtral_loss(module: MixtralForCausalLM, params, input_ids, labels,
+                 ignore_index: int = -100) -> jax.Array:
+    """CE + sown MoE aux losses (the reference threads the aux loss out of
+    the MoE block and adds it in the example training loop,
+    ``examples/training/mixtral``)."""
+    logits, mut = module.apply({"params": params}, input_ids, mutable=["losses"])
+    ce = parallel_cross_entropy_mean(logits, labels, ignore_index=ignore_index)
+    return ce + collect_aux_losses(mut)

@@ -16,11 +16,19 @@ TPU-native re-design (GShard/Switch dispatch algebra under GSPMD):
   all-to-all the reference issues by hand (``mappings.py:311-338``).
 * **all_experts mode**: every expert computes every token, outputs weighted
   by the combine matrix — no dropping, O(E) FLOPs, for small E or goldens.
-* **selective loading** (per-token expert gather for token-gen inference)
-  arrives with the inference stack.
+* **selective loading mode** (reference ``forward_selective_loading``,
+  expert_mlps.py:267): token generation has few tokens, so only the chosen
+  ``top_k`` experts' weights are gathered from HBM per token — the decode
+  step reads ``T*k`` expert weight slices instead of all ``E`` (HBM
+  bandwidth is the decode bottleneck). The reference's per-token Python loop
+  becomes one batched gather + einsum; the same
+  ``T*top_k/E < threshold`` dispatch rule picks selective vs all-experts
+  (expert_mlps.py:297 ``forward``'s inference branch).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -111,7 +119,35 @@ class ExpertMLPs(nn.Module):
         out = self._mlp(h)                                         # (E, T, H)
         return jnp.einsum("eth,te->th", out, combine.astype(out.dtype)).astype(x.dtype)
 
-    def __call__(self, x: jax.Array, combine: jax.Array) -> jax.Array:
+    # --- selective loading (token-gen inference) -------------------------
+
+    def forward_selective(self, x: jax.Array, combine: jax.Array,
+                          top_k: int) -> jax.Array:
+        """Gather only the chosen experts' weights per token (reference
+        forward_selective_loading, expert_mlps.py:267-297 — its per-token
+        loop is a batched take+einsum here). ``combine`` must have exactly
+        ``top_k`` nonzeros per row (the router guarantees it); no tokens are
+        dropped, so the result equals all_experts exactly."""
+        in_dtype = x.dtype
+        aff, idx = jax.lax.top_k(combine, top_k)                   # (T, k)
+        x = x.astype(self.dtype)
+        wg = jnp.take(self.w_gate, idx, axis=0).astype(self.dtype)  # (T, k, H, I)
+        wd = jnp.take(self.w_down, idx, axis=0).astype(self.dtype)  # (T, k, I, H)
+        g = jnp.einsum("th,tkhi->tki", x, wg)
+        if self.glu:
+            wu = jnp.take(self.w_up, idx, axis=0).astype(self.dtype)
+            a = nn.silu(g) * jnp.einsum("th,tkhi->tki", x, wu)
+        else:
+            a = nn.gelu(g)
+        out_k = jnp.einsum("tki,tkih->tkh", a, wd)                 # (T, k, H)
+        return jnp.einsum("tkh,tk->th", out_k, aff.astype(out_k.dtype)).astype(in_dtype)
+
+    def __call__(self, x: jax.Array, combine: jax.Array,
+                 top_k: Optional[int] = None) -> jax.Array:
+        if self.mode == "selective":
+            if top_k is None:
+                raise ValueError("selective mode needs the router's top_k")
+            return self.forward_selective(x, combine, top_k)
         if self.mode == "capacity_factor":
             return self.forward_capacity_factor(x, combine)
         if self.mode == "all_experts":

@@ -36,6 +36,11 @@ class MoE(nn.Module):
     z_loss_coef: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+    # inference dispatch (reference expert_mlps.py:297 forward): token-gen
+    # steps (seq==1) use selective loading when T*top_k/E is below the
+    # threshold, else all_experts; context encoding keeps `mode`
+    inference: bool = False
+    selective_loading_threshold: float = 0.5
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -55,13 +60,38 @@ class MoE(nn.Module):
             raise ValueError(f"unknown router {self.router!r}")
         combine, logits = router(flat)
 
+        mode = self.mode
+        if self.inference:
+            from neuronx_distributed_tpu.parallel import mesh as ps
+
+            ep = (ps.get_expert_model_parallel_size()
+                  if ps.model_parallel_is_initialized() else 1)
+            if s == 1:  # token generation (static shapes)
+                tokens = b * s
+                use_selective = (
+                    tokens * self.top_k / self.num_experts
+                    < self.selective_loading_threshold
+                    # selective gathers along the EP-sharded expert axis, which
+                    # GSPMD would service by all-gathering ALL expert weights —
+                    # defeating the point (the reference likewise excludes EP
+                    # from token-gen inference, SURVEY §2.3)
+                    and ep == 1
+                )
+                mode = "selective" if use_selective else "all_experts"
+            elif mode == "capacity_factor":
+                # context encoding must not drop tokens: a dropped assignment
+                # would corrupt the KV cache for the whole generation. The
+                # reference's serving configs run full capacity for the same
+                # reason (capacity_factor=None -> all_experts).
+                mode = "all_experts"
         experts = ExpertMLPs(
             num_experts=self.num_experts, hidden_size=h,
             intermediate_size=self.intermediate_size, glu=self.glu,
-            capacity_factor=self.capacity_factor, mode=self.mode,
+            capacity_factor=self.capacity_factor, mode=mode,
             dtype=self.dtype, param_dtype=self.param_dtype, name="experts",
         )
-        out = experts(flat, combine.astype(flat.dtype)).reshape(b, s, h)
+        out = experts(flat, combine.astype(flat.dtype),
+                      top_k=self.top_k).reshape(b, s, h)
 
         aux = self.aux_loss_coef * load_balancing_loss(logits, combine, self.num_experts)
         if self.z_loss_coef:

@@ -75,3 +75,10 @@ def test_inference_runner_generate_tiny(capsys):
     runner.main(["generate", "--tiny", "--max_new_tokens", "4"])
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert len(lines) >= 1 and len(lines[0]["generated"]) == 4
+
+
+def test_mixtral_moe_tiny():
+    import mixtral_moe
+
+    loss = mixtral_moe.main(["--tiny", "--steps", "2", "--log_every", "0"])
+    assert np.isfinite(loss)

@@ -32,6 +32,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.checkpoint.storage import (
@@ -136,6 +137,27 @@ def save_checkpoint(
 
     snapshot = jax.tree.map(snap, state)
 
+    # Multi-host protocol (reference rendezvouses around checkpoint IO,
+    # trainer/checkpoint.py:131,178-182): process 0 owns every control-plane
+    # write (cleanup, markers, retention); barriers fence payload writes so
+    # (a) no host writes payload before p0 invalidated a stale done marker,
+    # (b) the done marker only appears after EVERY host finished its shards.
+    n_procs = jax.process_count()
+    is_p0 = jax.process_index() == 0
+
+    def all_ok(ok: bool, name: str) -> bool:
+        """Barrier that also AGREES on success: every host reaches it even if
+        its local work failed (no stragglers stuck in a collective — the
+        deadlock mode of a bare barrier after a raising section), and the
+        checkpoint only proceeds/completes if EVERY host succeeded."""
+        if n_procs == 1:
+            return ok
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            jnp.asarray([1.0 if ok else 0.0]))
+        return bool(np.asarray(flags).min() >= 1.0)
+
     def write():
         # ALL control-plane work happens here: with async saves the 1-worker
         # executor serializes cleanup/markers/writes/retention, so a pending
@@ -143,47 +165,72 @@ def save_checkpoint(
         # class the reference fences with rendezvous, checkpoint.py:274-280)
         import orbax.checkpoint as ocp
 
-        storage.makedirs()
-        started, done = _tags_with_state(storage)
-        for t in started:  # reference _determine_remove_tags:62-89
-            if t not in done and t != tag:
-                logger.warning("removing interrupted checkpoint %r", t)
-                storage.remove_dir(t)
-        storage.makedirs(tag)
-        storage.save_text("", f"{tag}/{_CHECKPOINT_MARKER}")
-        # re-saving an existing tag: invalidate its old completion FIRST so a
-        # crash mid-overwrite can't leave a half-written payload marked done
-        storage.remove_file(f"{tag}/{_DONE_MARKER}")
-        # completion sequence continues across process restarts: next = max+1
-        seq = 0
-        for t in _tags_with_state(storage)[1]:
+        err: Optional[Exception] = None
+        if is_p0:
             try:
-                seq = max(seq, int(float(storage.load_text(f"{t}/{_DONE_MARKER}"))))
-            except ValueError:
-                pass
-        seq += 1
+                storage.makedirs()
+                started, done = _tags_with_state(storage)
+                for t in started:  # reference _determine_remove_tags:62-89
+                    if t not in done and t != tag:
+                        logger.warning("removing interrupted checkpoint %r", t)
+                        storage.remove_dir(t)
+                storage.makedirs(tag)
+                storage.save_text("", f"{tag}/{_CHECKPOINT_MARKER}")
+                # re-saving an existing tag: invalidate its old completion
+                # FIRST so a crash mid-overwrite can't leave a half-written
+                # payload marked done
+                storage.remove_file(f"{tag}/{_DONE_MARKER}")
+            except Exception as e:  # noqa: BLE001 — must still reach the barrier
+                err = e
+        if not all_ok(err is None, "begin"):
+            raise RuntimeError(f"checkpoint {tag!r}: control-plane begin failed") from err
 
-        path = storage.abspath(f"{tag}/{_PAYLOAD_DIR}")
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(path, snapshot, force=True)
-        if user_content is not None:
-            storage.save_text(json.dumps(user_content), f"{tag}/{_USER_CONTENT}")
-        storage.save_text(str(seq), f"{tag}/{_DONE_MARKER}")
-        # retention AFTER completion (reference removes done first :233-242)
-        if num_kept is not None and num_kept > 0:
-            _, done_now = _tags_with_state(storage)
-            order = sorted(
-                done_now, key=lambda t: float(storage.load_text(f"{t}/{_DONE_MARKER}"))
-            )
-            for old in order[:-num_kept]:
-                storage.remove_file(f"{old}/{_DONE_MARKER}")
-                storage.remove_dir(old)
+        try:
+            path = storage.abspath(f"{tag}/{_PAYLOAD_DIR}")
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(path, snapshot, force=True)
+        except Exception as e:  # noqa: BLE001 — must still reach the barrier
+            err = e
+        # every host's shards durable before the completion marker; if ANY
+        # host failed, no done marker — the tag stays "interrupted" and the
+        # next save cleans it up
+        if not all_ok(err is None, "end"):
+            raise RuntimeError(f"checkpoint {tag!r}: payload write failed") from err
+        if is_p0:
+            # completion sequence continues across restarts: next = max+1
+            seq = 0
+            for t in _tags_with_state(storage)[1]:
+                try:
+                    seq = max(seq, int(float(storage.load_text(f"{t}/{_DONE_MARKER}"))))
+                except ValueError:
+                    pass
+            seq += 1
+            if user_content is not None:
+                storage.save_text(json.dumps(user_content), f"{tag}/{_USER_CONTENT}")
+            storage.save_text(str(seq), f"{tag}/{_DONE_MARKER}")
+            # retention AFTER completion (reference removes done first :233-242)
+            if num_kept is not None and num_kept > 0:
+                _, done_now = _tags_with_state(storage)
+                order = sorted(
+                    done_now,
+                    key=lambda t: float(storage.load_text(f"{t}/{_DONE_MARKER}")),
+                )
+                for old in order[:-num_kept]:
+                    storage.remove_file(f"{old}/{_DONE_MARKER}")
+                    storage.remove_dir(old)
 
     if has_remote and async_save:
         logger.warning(
             "async_save downgraded to sync: state contains multi-host arrays "
             "whose device buffers cannot be host-snapshotted (donation safety)"
         )
+        async_save = False
+    if n_procs > 1 and async_save:
+        # the barriers are device collectives; issuing them from the
+        # background worker would race the training program on the same
+        # devices (the reference's async path rendezvouses on the main
+        # thread for the same reason)
+        logger.warning("async_save downgraded to sync in multi-host mode")
         async_save = False
     # BOTH paths go through the 1-worker executor so cleanup/markers/retention
     # are serialized against any pending async save; sync just blocks on it

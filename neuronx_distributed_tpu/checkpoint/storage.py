@@ -1,17 +1,25 @@
 """Checkpoint storage abstraction (reference ``trainer/checkpoint_storage.py``
 — ``BaseCheckpointStorage``:28, ``FilesysCheckpointStorage``:120,
-``S3CheckpointStorage``:219, factory ``create_checkpoint_storage``:558).
+``S3CheckpointStorage``:219 with retrying ops :280, factory
+``create_checkpoint_storage``:558).
 
 The tensor payload is written by orbax/tensorstore (which has its own gcs/s3
 drivers); this abstraction covers the *control plane* the reference keeps on
 storage: tag directories, marker files, listing, retention deletes.
-"""
+:class:`ObjectStoreCheckpointStorage` serves object-store URLs through
+tensorstore's kvstore drivers — no boto3/gcsfs dependency, the same library
+that already moves the payload (the TPU-native replacement for the
+reference's boto3 S3 client)."""
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("nxd")
 
 
 class BaseCheckpointStorage:
@@ -87,14 +95,93 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
         os.makedirs(self.abspath(path), exist_ok=True)
 
 
+def _retry(fn: Callable, attempts: int = 3, base_delay: float = 0.5):
+    """Retry with exponential backoff (reference ``_list_with_retry``,
+    checkpoint_storage.py:280 — same policy for every object-store op)."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — storage errors are driver-specific
+            if i == attempts - 1:
+                raise
+            delay = base_delay * (2 ** i)
+            logger.warning("storage op failed (%s); retry %d/%d in %.1fs",
+                           e, i + 1, attempts, delay)
+            time.sleep(delay)
+
+
+class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
+    """Control plane on an object store via tensorstore kvstore drivers
+    (reference ``S3CheckpointStorage``:219; here gs://, s3://, and the
+    memory:// / file:// drivers used by hermetic tests all ride the same
+    code). Objects replace files; "directories" are key prefixes; dir
+    markers are unnecessary because listing is prefix-based."""
+
+    def __init__(self, url: str):
+        super().__init__(url.rstrip("/"))
+        import tensorstore as ts
+
+        self._ts = ts
+        self._kv = ts.KvStore.open(self.dirname + "/").result()
+
+    # --- key helpers ---
+    def _key(self, path: str) -> str:
+        return path.strip("/")
+
+    def dir_exists(self, path: str) -> bool:
+        prefix = self._key(path) + "/"
+        return bool(_retry(lambda: self._kv.list(
+            self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")).result()))
+
+    def file_exists(self, path: str) -> bool:
+        r = _retry(lambda: self._kv.read(self._key(path)).result())
+        return r.state == "value"
+
+    def save_text(self, text: str, path: str) -> None:
+        _retry(lambda: self._kv.write(self._key(path), text.encode()).result())
+
+    def load_text(self, path: str) -> str:
+        r = _retry(lambda: self._kv.read(self._key(path)).result())
+        if r.state != "value":
+            raise FileNotFoundError(f"{self.dirname}/{path}")
+        return r.value.decode()
+
+    def list_dirs(self, path: str = "") -> List[str]:
+        prefix = (self._key(path) + "/") if path else ""
+        keys = _retry(lambda: self._kv.list(
+            self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")
+            if prefix else self._ts.KvStore.KeyRange()).result())
+        dirs = set()
+        for k in keys:
+            rest = k.decode()[len(prefix):]
+            if "/" in rest:
+                dirs.add(rest.split("/", 1)[0])
+        return sorted(dirs)
+
+    def remove_dir(self, path: str) -> None:
+        prefix = self._key(path) + "/"
+        _retry(lambda: self._kv.delete_range(
+            self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")).result())
+
+    def remove_file(self, path: str) -> None:
+        _retry(lambda: self._kv.write(self._key(path), None).result())
+
+    def makedirs(self, path: str = "") -> None:
+        pass  # prefixes need no creation
+
+    def abspath(self, path: str = "") -> str:
+        """Payload paths hand off to orbax/tensorstore: gs://-style URLs pass
+        through (orbax speaks them natively); file:// strips the scheme so
+        orbax writes the plain path (the hermetic-test vehicle)."""
+        url = f"{self.dirname}/{path}" if path else self.dirname
+        if url.startswith("file://"):
+            return url[len("file://"):]
+        return url
+
+
 def create_checkpoint_storage(dirname: str) -> BaseCheckpointStorage:
-    """Factory (reference :558). Object-store URLs (s3://, gs://) delegate the
-    tensor payload to tensorstore drivers; the control plane currently
-    requires a filesystem view (mount or local cache)."""
-    if dirname.startswith(("s3://", "gs://")):
-        raise NotImplementedError(
-            "object-store control plane not wired yet: mount the bucket "
-            "(gcsfuse / mountpoint-s3) and pass the mount path; tensor IO "
-            "already rides tensorstore"
-        )
+    """Factory (reference :558): object-store URLs get the kvstore-backed
+    control plane, everything else the filesystem one."""
+    if "://" in dirname:
+        return ObjectStoreCheckpointStorage(dirname)
     return FilesysCheckpointStorage(dirname)

@@ -299,14 +299,16 @@ def _remat_policy(name: Optional[str]):
 
 class _LayerStep(nn.Module):
     """Scan body: one (optionally remat-wrapped) decoder layer returning the
-    ``(carry, ys)`` pair ``nn.scan`` expects."""
+    ``(carry, ys)`` pair ``nn.scan`` expects. ``layer_cls`` parameterizes the
+    decoder block so variants (Mixtral's MoE layer) reuse the whole stack."""
 
     config: LlamaConfig
+    layer_cls: Any = None  # default LlamaDecoderLayer (set below)
 
     @nn.compact
     def __call__(self, x, rope):
         cfg = self.config
-        cls = LlamaDecoderLayer
+        cls = self.layer_cls or LlamaDecoderLayer
         policy = _remat_policy(cfg.remat_policy)
         if policy is not None:
             cls = nn.remat(cls, policy=policy, prevent_cse=False)
@@ -318,6 +320,7 @@ class LlamaModel(nn.Module):
     ``(batch, seq, hidden)``; SP keeps seq sharded between attention/MLP."""
 
     config: LlamaConfig
+    layer_cls: Any = None
 
     def setup(self):
         cfg = self.config
@@ -326,15 +329,16 @@ class LlamaModel(nn.Module):
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
         )
         # scan over layers: one compiled body, params stacked on a leading
-        # (unsharded) layer axis
+        # (unsharded) layer axis. "losses" carries per-layer sown aux losses
+        # (MoE variants); unused collections in variable_axes are harmless.
         self.layers = nn.scan(
             _LayerStep,
-            variable_axes={"params": 0, "cache": 0},
+            variable_axes={"params": 0, "cache": 0, "losses": 0},
             split_rngs={"params": True},
             length=cfg.num_layers,
             in_axes=nn.broadcast,
             metadata_params={nn.meta.PARTITION_NAME: None},
-        )(cfg)
+        )(cfg, self.layer_cls)
         self.final_norm = RMSNorm(
             epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel,
@@ -366,11 +370,12 @@ class LlamaForCausalLM(nn.Module):
     gathered logits (reference ``parallel_cross_entropy`` wiring)."""
 
     config: LlamaConfig
+    layer_cls: Any = None  # decoder-block override (e.g. Mixtral's MoE layer)
 
     @nn.compact
     def __call__(self, input_ids: jax.Array) -> jax.Array:
         cfg = self.config
-        model = LlamaModel(cfg, name="model")
+        model = LlamaModel(cfg, self.layer_cls, name="model")
         x = model(input_ids)
         if cfg.sequence_parallel:
             x = constrain(x, ACT_FULL)

@@ -16,23 +16,17 @@ import dataclasses
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from flax import linen as nn
 
 from neuronx_distributed_tpu.models.llama import (
     LlamaAttention,
     LlamaConfig,
-    _remat_policy,
-    rotary_embedding,
+    LlamaForCausalLM,
+    LlamaModel,
 )
 from neuronx_distributed_tpu.moe.layer import MoE, collect_aux_losses
-from neuronx_distributed_tpu.parallel.layers import (
-    ColumnParallelLinear,
-    ParallelEmbedding,
-    RMSNorm,
-)
+from neuronx_distributed_tpu.parallel.layers import RMSNorm
 from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy_mean
-from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, ACT_SP, constrain
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,71 +80,20 @@ class MixtralDecoderLayer(nn.Module):
         return x + moe_out
 
 
-class _MixtralLayerStep(nn.Module):
-    config: MixtralConfig
+class MixtralModel(LlamaModel):
+    """The Llama stack with the MoE decoder block (embed/rope/scan/final-norm
+    are shared — parameterized by ``layer_cls``, no copy)."""
 
-    @nn.compact
-    def __call__(self, x, rope):
-        cls = MixtralDecoderLayer
-        policy = _remat_policy(self.config.remat_policy)
-        if policy is not None:
-            cls = nn.remat(cls, policy=policy, prevent_cse=False)
-        return cls(self.config, name="block")(x, rope), None
+    layer_cls: Any = MixtralDecoderLayer
 
 
-class MixtralModel(nn.Module):
-    config: MixtralConfig
+class MixtralForCausalLM(LlamaForCausalLM):
+    """LlamaForCausalLM with the MoE decoder block: same vocab-parallel head,
+    same ``tie_word_embeddings`` handling. The aux (load-balancing) losses
+    are sown into the ``"losses"`` collection per layer; use
+    :func:`mixtral_loss` to train with them included."""
 
-    def setup(self):
-        cfg = self.config
-        self.embed = ParallelEmbedding(
-            cfg.vocab_size, cfg.hidden_size, shard_over="vocab",
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-        )
-        self.layers = nn.scan(
-            _MixtralLayerStep,
-            variable_axes={"params": 0, "cache": 0, "losses": 0},
-            split_rngs={"params": True},
-            length=cfg.num_layers,
-            in_axes=nn.broadcast,
-            metadata_params={nn.meta.PARTITION_NAME: None},
-        )(cfg)
-        self.final_norm = RMSNorm(
-            epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel,
-        )
-
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
-        cfg = self.config
-        if input_ids.shape[1] > cfg.max_seq_len:
-            raise ValueError(
-                f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
-            )
-        x = self.embed(input_ids)
-        positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
-        rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
-        x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
-        x, _ = self.layers(x, rope)
-        return self.final_norm(x)
-
-
-class MixtralForCausalLM(nn.Module):
-    """Model + vocab-parallel LM head. The aux (load-balancing) losses are
-    sown into the ``"losses"`` collection per layer; use :func:`mixtral_loss`
-    to train with them included."""
-
-    config: MixtralConfig
-
-    @nn.compact
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
-        cfg = self.config
-        x = MixtralModel(cfg, name="model")(input_ids)
-        if cfg.sequence_parallel:
-            x = constrain(x, ACT_FULL)
-        return ColumnParallelLinear(
-            cfg.vocab_size, use_bias=False, gather_output=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
-        )(x)
+    layer_cls: Any = MixtralDecoderLayer
 
 
 def mixtral_loss(module: MixtralForCausalLM, params, input_ids, labels,

@@ -134,3 +134,120 @@ def test_train_state_resume(tmp_path):
         restored = jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(state), jax.tree.leaves(restored))
     _, m = step(restored, batch, jax.random.key(2))
     np.testing.assert_allclose(float(m["loss"]), expected, rtol=1e-6)
+
+
+# --- object-store storage (tensorstore kvstore control plane) --------------
+
+def test_object_store_control_plane_memory():
+    """All control-plane ops against the kvstore memory driver (stands in
+    for gs://, same code path; reference S3CheckpointStorage surface)."""
+    from neuronx_distributed_tpu.checkpoint.storage import create_checkpoint_storage
+
+    st = create_checkpoint_storage("memory://bucket/ckpts")
+    assert type(st).__name__ == "ObjectStoreCheckpointStorage"
+    assert st.list_dirs() == []
+    st.save_text("", "t1/checkpoint")
+    st.save_text("1", "t1/done")
+    st.save_text("", "t2/checkpoint")
+    assert st.list_dirs() == ["t1", "t2"]
+    assert st.dir_exists("t1") and not st.dir_exists("t3")
+    assert st.file_exists("t1/done") and not st.file_exists("t2/done")
+    assert st.load_text("t1/done") == "1"
+    st.remove_file("t1/done")
+    assert not st.file_exists("t1/done")
+    st.remove_dir("t2")
+    assert st.list_dirs() == ["t1"]
+    with pytest.raises(FileNotFoundError):
+        st.load_text("t2/done")
+
+
+def test_object_store_full_roundtrip_file_url(tmp_path):
+    """End-to-end save/load through the object-store storage class using the
+    kvstore file driver (hermetic stand-in for gs://): markers, retention,
+    payload, and resume all ride the object-store code path."""
+    from neuronx_distributed_tpu.checkpoint import (
+        has_checkpoint, latest_tag, load_checkpoint, save_checkpoint,
+    )
+
+    url = "file://" + str(tmp_path / "bucket")
+    state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "step": np.int32(7)}
+    save_checkpoint(url, "t1", state, user_content={"step": 7})
+    save_checkpoint(url, "t2", state, num_kept=1)
+    assert has_checkpoint(url)
+    assert latest_tag(url) == "t2"
+    restored, _ = load_checkpoint(url, "t2")
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    # retention dropped t1
+    from neuronx_distributed_tpu.checkpoint.storage import create_checkpoint_storage
+
+    st = create_checkpoint_storage(url)
+    assert "t1" not in st.list_dirs()
+
+
+def test_object_store_interrupted_cleanup():
+    """A tag with a checkpoint marker but no done marker is removed by the
+    next save (reference _determine_remove_tags:62-89) — object-store path."""
+    from neuronx_distributed_tpu.checkpoint.storage import create_checkpoint_storage
+
+    url = "memory://bucket2/ck"
+    st = create_checkpoint_storage(url)
+    st.save_text("", "dead/checkpoint")
+    st.save_text("junk", "dead/payload/x")
+    from neuronx_distributed_tpu.checkpoint.core import _tags_with_state
+
+    started, done = _tags_with_state(st)
+    assert "dead" in started and "dead" not in done
+
+
+def test_resume_exactly_reproduces_straight_run(tmp_path):
+    """Train 4 steps straight vs 2 + checkpoint + restore + 2: identical
+    params bit-for-bit (resume-mid-training integration; VERDICT r1 #9)."""
+    from neuronx_distributed_tpu.checkpoint import load_checkpoint, save_checkpoint
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        create_train_state, initialize_parallel_model,
+        initialize_parallel_optimizer, make_train_step,
+        neuronx_distributed_config,
+    )
+
+    lcfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                       num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=32,
+                       dtype=jnp.float32, use_flash_attention=False, remat_policy=None)
+    cfg = neuronx_distributed_config(tensor_parallel_size=2)
+    rs = np.random.RandomState(0)
+    batches = [{"ids": rs.randint(0, 127, (4, 16)), "labels": rs.randint(0, 127, (4, 16))}
+               for _ in range(4)]
+
+    def build():
+        model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg),
+                                          batches[0]["ids"])
+        opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-3,
+                                            weight_decay=0.0)
+
+        def loss_fn(params, b, rng):
+            return model.module.apply({"params": params}, b["ids"], b["labels"],
+                                      method=LlamaForCausalLM.loss)
+
+        return model, opt, make_train_step(model, opt, loss_fn)
+
+    model, opt, step = build()
+    state = create_train_state(model, opt)
+    for i in range(4):
+        state, _ = step(state, batches[i], jax.random.key(i))
+    straight = jax.tree.map(np.asarray, state.params)
+
+    ps.destroy_model_parallel()
+    model, opt, step = build()
+    state = create_train_state(model, opt)
+    for i in range(2):
+        state, _ = step(state, batches[i], jax.random.key(i))
+    save_checkpoint(str(tmp_path / "ck"), "mid", state)
+
+    # the live mid-training state supplies shapes + shardings for the restore
+    state2, _ = load_checkpoint(str(tmp_path / "ck"), "mid", target=state)
+    for i in range(2, 4):
+        state2, _ = step(state2, batches[i], jax.random.key(i))
+    resumed = jax.tree.map(np.asarray, state2.params)
+    jax.tree.map(np.testing.assert_array_equal, straight, resumed)

@@ -259,3 +259,20 @@ def test_ep_sharded_checkpoint_roundtrip(tmp_path):
     )
     r_gate = restored["model"]["layers"]["block"]["moe"]["experts"]["gate"]
     assert r_gate.sharding.spec == gate.sharding.spec
+
+
+def test_mixtral_tied_embeddings():
+    """Mixtral inherits the Llama head: tie_word_embeddings must reuse the
+    embedding table (no separate lm_head params) — regression for the copy
+    that dropped it (r2 review)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+    cfg = _mixtral_cfg(tie_word_embeddings=True, moe_mode="all_experts")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 127)
+    model = MixtralForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), ids))["params"]
+    assert "lm_head" not in params
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 8, cfg.vocab_size)

@@ -1,0 +1,273 @@
+"""Medusa tree decoding (reference ``utils/medusa_utils.py`` —
+``generate_medusa_buffers``:32, candidate generation / posterior evaluation —
+and ``utils/speculative_decoding.py`` ``_medusa_assisted_decoding``:189).
+
+Medusa adds ``H`` extra LM heads to the base model; head ``i`` predicts the
+token at offset ``i+2`` from the current position. Each round:
+
+1. build a CANDIDATE TREE from the heads' top-k tokens (the ``medusa_choices``
+   tree shape — node ``[a, b]`` means "head 1's a-th choice followed by head
+   2's b-th choice");
+2. verify the whole tree in ONE cached forward using a tree attention mask
+   (node attends prefix + its ancestors) and depth-based RoPE positions;
+3. greedily accept the longest tree path whose tokens match the verifier's
+   argmax chain (``evaluate_posterior``);
+4. replay the accepted tokens through a contiguous chunk forward — this
+   both compacts the KV cache (tree nodes land on scattered slots; the
+   reference compacts via its ``accepted_indices`` gather machinery) and
+   yields the next round's base+medusa logits in the same call.
+
+The tree mask rides the ``chunk_ctx`` hook in the Llama attention
+(models/llama.py ``cached_attention`` mask override).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from neuronx_distributed_tpu.inference.causal_lm import (
+    GenerationResult,
+    _set_cache_index,
+    infer_prompt_lengths,
+)
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaModel
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
+from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, constrain
+
+TOPK = 10  # per-head candidate pool (reference medusa_utils.py:4)
+
+# a compact default tree for 2 heads (the reference ships the 63-node
+# mc_sim_7b_63 for 4 heads; any nested-choice list works)
+DEFAULT_CHOICES: Tuple[Tuple[int, ...], ...] = (
+    (0,), (1,), (2,), (0, 0), (0, 1), (1, 0),
+)
+
+
+class MedusaLlamaForCausalLM(nn.Module):
+    """Llama + Medusa heads. Each head is the original Medusa ResBlock
+    (``x + silu(W x)``, zero-init W so the head starts as the base lm_head)
+    followed by its own vocab-parallel head. Returns
+    ``(logits, medusa_logits (H, b, s, vocab))``."""
+
+    config: LlamaConfig
+    num_medusa_heads: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, chunk_ctx=None):
+        cfg = self.config
+        x = LlamaModel(cfg, name="model")(input_ids, chunk_ctx)
+        if cfg.sequence_parallel:
+            x = constrain(x, ACT_FULL)
+        logits = ColumnParallelLinear(
+            cfg.vocab_size, use_bias=False, gather_output=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+        med = []
+        for i in range(self.num_medusa_heads):
+            r = x + nn.silu(nn.Dense(
+                cfg.hidden_size, use_bias=True,
+                kernel_init=nn.initializers.zeros_init(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name=f"medusa_res_{i}",
+            )(x))
+            med.append(ColumnParallelLinear(
+                cfg.vocab_size, use_bias=False, gather_output=False,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name=f"medusa_head_{i}",
+            )(r))
+        return logits, jnp.stack(med)
+
+
+def generate_medusa_buffers(medusa_choices: Sequence[Sequence[int]]) -> Dict[str, np.ndarray]:
+    """Static tree buffers (reference generate_medusa_buffers:32): ancestor
+    attention mask, indices into the candidate pool, depth position ids, and
+    per-path node indices for verification (pad = -1)."""
+    choices = sorted((tuple(c) for c in medusa_choices), key=lambda x: (len(x), x))
+    if len(set(choices)) != len(choices):
+        raise ValueError("duplicate medusa choice")
+    m = len(choices) + 1
+    index = {(): 0}
+    for i, path in enumerate(choices):
+        if path[:-1] not in index:
+            raise ValueError(f"choice {path} has no parent {path[:-1]} in the tree")
+        if path[-1] >= TOPK:
+            raise ValueError(f"choice {path} exceeds per-head top-{TOPK} pool")
+        index[path] = i + 1
+
+    attn = np.eye(m, dtype=bool)
+    attn[:, 0] = True
+    tree_idx = np.zeros(m, np.int32)
+    pos = np.zeros(m, np.int32)
+    for i, path in enumerate(choices):
+        for c in range(len(path) - 1):
+            attn[i + 1, index[path[: c + 1]]] = True
+        # candidate pool layout: [base_top1] + head0 topk + head1 topk + ...
+        tree_idx[i + 1] = 1 + (len(path) - 1) * TOPK + path[-1]
+        pos[i + 1] = len(path)
+
+    leaves = [p for p in choices
+              if not any(len(q) > len(p) and q[: len(p)] == p for q in choices)]
+    depth = max(len(p) for p in choices)
+    retrieve = np.full((len(leaves), depth + 1), -1, np.int32)
+    for r, p in enumerate(leaves):
+        retrieve[r, 0] = 0
+        for c in range(len(p)):
+            retrieve[r, c + 1] = index[p[: c + 1]]
+    return {
+        "attn_mask": attn,                 # (m, m) node x node ancestry
+        "tree_indices": tree_idx,          # (m,) into the candidate pool
+        "position_ids": pos,               # (m,) depth offsets
+        "retrieve_indices": retrieve,      # (paths, depth+1), -1 = pad
+        "depth": depth,
+        "num_nodes": m,
+    }
+
+
+def generate_candidates(base_logits: np.ndarray, medusa_logits: np.ndarray,
+                        buffers: Dict[str, np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+    """Candidate pool + tree token assignment (reference generate_candidates).
+    ``base_logits``: (V,); ``medusa_logits``: (H, V). Returns
+    ``(tree_tokens (m,), candidates (paths, depth+1))``."""
+    pool = [int(np.argmax(base_logits))]
+    for h in range(medusa_logits.shape[0]):
+        topk = np.argsort(medusa_logits[h])[::-1][:TOPK]
+        pool.extend(int(t) for t in topk)
+    pool_arr = np.asarray(pool, np.int64)
+    tree_tokens = pool_arr[buffers["tree_indices"]]
+    ri = buffers["retrieve_indices"]
+    candidates = np.where(ri >= 0, tree_tokens[np.clip(ri, 0, None)], -1)
+    return tree_tokens, candidates
+
+
+def evaluate_posterior_greedy(path_argmax: np.ndarray, candidates: np.ndarray
+                              ) -> Tuple[int, int]:
+    """Longest greedy-consistent path (reference evaluate_posterior, greedy
+    posterior): accept ``candidates[p, j+1]`` while it equals the verifier's
+    argmax at node j. Returns ``(best_path, accept_len)`` where accept_len
+    counts accepted tokens BEYOND the root."""
+    paths, width = candidates.shape
+    best, best_len = 0, 0
+    for p in range(paths):
+        acc = 0
+        for j in range(width - 1):
+            if candidates[p, j + 1] < 0:
+                break
+            if candidates[p, j + 1] == path_argmax[p, j]:
+                acc += 1
+            else:
+                break
+        if acc > best_len:
+            best, best_len = p, acc
+    return best, best_len
+
+
+def medusa_generate(
+    config: LlamaConfig,
+    params: Any,
+    prompt_ids: np.ndarray,
+    max_new_tokens: int,
+    num_medusa_heads: int = 2,
+    medusa_choices: Sequence[Sequence[int]] = DEFAULT_CHOICES,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    prompt_length: Optional[int] = None,
+    bucket: Optional[int] = None,
+) -> GenerationResult:
+    """Medusa tree decoding, batch 1 (the reference's loop is per-sequence,
+    speculative_decoding.py:189). ``params`` must contain the medusa head
+    params (``MedusaLlamaForCausalLM`` tree)."""
+    if prompt_ids.shape[0] != 1:
+        raise ValueError("medusa_generate handles batch size 1")
+    buffers = generate_medusa_buffers(medusa_choices)
+    if buffers["depth"] > num_medusa_heads:
+        raise ValueError(
+            f"tree depth {buffers['depth']} exceeds num_medusa_heads {num_medusa_heads}"
+        )
+    cfg = dataclasses.replace(config, decode=True, sequence_parallel=False,
+                              remat_policy=None)
+    model = MedusaLlamaForCausalLM(cfg, num_medusa_heads=num_medusa_heads)
+
+    s = prompt_ids.shape[1]
+    bucket = bucket or s
+    length = (int(prompt_length) if prompt_length is not None
+              else int(infer_prompt_lengths(prompt_ids, pad_token_id)[0]))
+    m = int(buffers["num_nodes"])
+    depth = int(buffers["depth"])
+    if length + max_new_tokens + m > cfg.max_seq_len:
+        raise ValueError("prompt + max_new_tokens + tree exceeds max_seq_len")
+
+    chunk_mask = jnp.asarray(buffers["attn_mask"])
+    chunk_pos = jnp.asarray(buffers["position_ids"])
+    ri = buffers["retrieve_indices"]
+
+    @jax.jit
+    def prefill(params, ids):
+        (logits, med), mut = model.apply({"params": params}, ids, None,
+                                         mutable=["cache"])
+        return logits, med, mut["cache"]
+
+    # donate the cache like every other decode-path program (CausalLM.compile,
+    # the speculative proposer): the KV cache is the dominant allocation
+    @partial(jax.jit, donate_argnums=(1,))
+    def tree_step(params, cache, tree_tokens):
+        (logits, med), mut = model.apply(
+            {"params": params, "cache": cache}, tree_tokens,
+            (chunk_mask, chunk_pos), mutable=["cache"],
+        )
+        return logits, mut["cache"]
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def replay(params, cache, tokens):
+        (logits, med), mut = model.apply(
+            {"params": params, "cache": cache}, tokens, None, mutable=["cache"]
+        )
+        return logits, med, mut["cache"]
+
+    ids = np.zeros((1, bucket), np.int32)
+    ids[0, :s] = prompt_ids[0]
+    logits, med, cache = prefill(params, jnp.asarray(ids))
+    cache = _set_cache_index(cache, jnp.asarray([length], jnp.int32))
+    last_logits = np.asarray(logits[0, length - 1], np.float32)    # (V,)
+    last_med = np.asarray(med[:, 0, length - 1], np.float32)       # (H, V)
+
+    out: List[int] = []
+    cur = length
+    while len(out) < max_new_tokens:
+        tree_tokens, candidates = generate_candidates(last_logits, last_med, buffers)
+        # one cached forward verifies the whole tree (tree mask + depth RoPE);
+        # nodes land on slots cur..cur+m-1 — invalidated by the rollback below
+        tree_logits, cache = tree_step(params, cache,
+                                       jnp.asarray(tree_tokens[None], jnp.int32))
+        tl = np.asarray(tree_logits[0], np.float32)                # (m, V)
+        path_argmax = np.argmax(tl[np.clip(ri, 0, None)], axis=-1)  # (paths, depth+1)
+        best, acc = evaluate_posterior_greedy(path_argmax, candidates)
+        accepted = [int(t) for t in candidates[best, : acc + 1]]
+
+        # rollback to cur, then replay the accepted tokens contiguously:
+        # compacts the KV cache (reference: accepted_indices gather) AND
+        # yields the next round's logits at the last accepted position
+        cache = _set_cache_index(cache, jnp.asarray([cur], jnp.int32))
+        chunk = np.zeros((1, depth + 1), np.int32)
+        chunk[0, : len(accepted)] = accepted
+        logits, med, cache = replay(params, cache, jnp.asarray(chunk))
+        cur += len(accepted)
+        cache = _set_cache_index(cache, jnp.asarray([cur], jnp.int32))
+        last_logits = np.asarray(logits[0, len(accepted) - 1], np.float32)
+        last_med = np.asarray(med[:, 0, len(accepted) - 1], np.float32)
+
+        out.extend(accepted)
+        if eos_token_id is not None and eos_token_id in accepted:
+            out = out[: out.index(eos_token_id) + 1]
+            break
+
+    out = out[:max_new_tokens]
+    tokens = np.zeros((1, max_new_tokens), np.int64)
+    tokens[0, : len(out)] = out
+    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32))

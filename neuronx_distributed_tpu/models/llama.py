@@ -114,7 +114,7 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
+def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None, mask=None):
     """Decode/prefill attention against a fixed-size KV cache.
 
     ``q``: (b, s_new, n, d) — queries at absolute positions
@@ -122,7 +122,11 @@ def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
     n_kv, d); key j is valid for query i iff ``j <= cache_len + i`` AND the
     slot has been written. The reference's KV-cache attention with
     bottom-aligned causal semantics (examples/inference/modules/
-    attention_base.py; SURVEY §2.2 inference examples row)."""
+    attention_base.py; SURVEY §2.2 inference examples row).
+
+    An explicit ``mask`` (b, s_new, S_max) overrides the positional default —
+    Medusa tree steps attend by tree ancestry, not linear position
+    (reference ``medusa_attn_mask``, utils/medusa_utils.py:59-73)."""
     b, s_new, n, d = q.shape
     n_kv = k_cache.shape[2]
     if n != n_kv:
@@ -136,9 +140,10 @@ def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
         cache_len = jnp.broadcast_to(cache_len, (b,))
     scores = jnp.einsum("bind,bjnd->bnij", q.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * sm_scale
-    qpos = cache_len[:, None] + jnp.arange(s_new)[None, :]      # (b, s_new)
-    kpos = jnp.arange(s_max)
-    mask = kpos[None, None, :] <= qpos[..., None]               # (b, s_new, s_max)
+    if mask is None:
+        qpos = cache_len[:, None] + jnp.arange(s_new)[None, :]  # (b, s_new)
+        kpos = jnp.arange(s_max)
+        mask = kpos[None, None, :] <= qpos[..., None]           # (b, s_new, s_max)
     scores = jnp.where(mask[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bnij,bjnd->bind", probs, v_cache.astype(jnp.float32))
@@ -149,7 +154,10 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, rope) -> jax.Array:
+    def __call__(self, x: jax.Array, rope, chunk_ctx=None) -> jax.Array:
+        """``chunk_ctx`` (decode only): ``(chunk_mask (s,s) bool,
+        chunk_positions (s,) int32)`` for Medusa tree steps — intra-chunk
+        visibility by tree ancestry and RoPE positions by tree depth."""
         cfg = self.config
         hd = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -163,7 +171,7 @@ class LlamaAttention(nn.Module):
             name="qkv",
         )(x)
         if cfg.decode:
-            return self._decode_attention(x, q, k, v)
+            return self._decode_attention(x, q, k, v, chunk_ctx)
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
@@ -186,7 +194,7 @@ class LlamaAttention(nn.Module):
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="o_proj",
         )(o)
 
-    def _decode_attention(self, x, q, k, v):
+    def _decode_attention(self, x, q, k, v, chunk_ctx=None):
         """KV-cached path (flax ``cache`` collection; the reference keeps KV
         state in aliased runtime buffers, model_base.py KV management —
         donation of the cache collection is the TPU analogue)."""
@@ -204,18 +212,40 @@ class LlamaAttention(nn.Module):
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((b,), jnp.int32))
         idx = ci.value                                            # (b,)
-        # unified write: s_new tokens land at positions idx..idx+s_new per
-        # slot — covers prefill (idx=0), single-token decode, and multi-token
-        # speculative verification chunks (reference CTX/TKG/speculation
-        # submodels, model_wrapper.py)
-        positions = idx[:, None] + jnp.arange(s_new, dtype=jnp.int32)[None, :]
+        # unified write: s_new tokens land at SLOTS idx..idx+s_new per slot —
+        # covers prefill (idx=0), single-token decode, multi-token
+        # speculative verification chunks, and Medusa tree chunks (reference
+        # CTX/TKG/speculation submodels + scatter_index, model_wrapper.py).
+        # Tree steps decouple the RoPE POSITION (tree depth) from the slot.
+        chunk_mask = chunk_positions = None
+        if chunk_ctx is not None:
+            chunk_mask, chunk_positions = chunk_ctx
+        slots = idx[:, None] + jnp.arange(s_new, dtype=jnp.int32)[None, :]
+        if chunk_positions is None:
+            positions = slots
+        else:
+            positions = idx[:, None] + chunk_positions[None, :].astype(jnp.int32)
         rows = jnp.arange(b)[:, None]
         cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        ck.value = ck.value.at[rows, positions].set(k.astype(ck.value.dtype))
-        cv.value = cv.value.at[rows, positions].set(v.astype(cv.value.dtype))
+        ck.value = ck.value.at[rows, slots].set(k.astype(ck.value.dtype))
+        cv.value = cv.value.at[rows, slots].set(v.astype(cv.value.dtype))
         ci.value = idx + s_new
+        if chunk_mask is not None:
+            # prefix slots (< idx) fully visible; chunk slots by tree mask
+            s_max = cfg.max_seq_len
+            kslot = jnp.arange(s_max)[None, None, :]              # (1,1,S)
+            prefix = kslot < idx[:, None, None]                   # (b,1,S)
+            rel = kslot - idx[:, None, None]                      # (b,1,S)
+            in_chunk = (rel >= 0) & (rel < s_new)
+            rel_c = jnp.broadcast_to(jnp.clip(rel, 0, s_new - 1), (b, s_new, s_max))
+            cm = jnp.broadcast_to(chunk_mask.astype(bool)[None], (b, s_new, s_new))
+            tree = jnp.take_along_axis(cm, rel_c.astype(jnp.int32), axis=2)
+            mask = prefix | (in_chunk & tree)
+            o = cached_attention(q, ck.value, cv.value, idx, mask=mask)
+            o = o.reshape(b, s_new, -1)
+            return self._o_proj(o)
         # prefill/chunk attention: the Pallas kernel with per-slot position
         # masks (q at idx..idx+s_new; key j visible iff j <= q position, which
         # also excludes unwritten cache slots). The reference likewise uses
@@ -275,11 +305,11 @@ class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, rope) -> jax.Array:
+    def __call__(self, x: jax.Array, rope, chunk_ctx=None) -> jax.Array:
         cfg = self.config
         h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
-        x = x + LlamaAttention(cfg, name="attention")(h, rope)
+        x = x + LlamaAttention(cfg, name="attention")(h, rope, chunk_ctx)
         h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
         return x + LlamaMLP(cfg, name="mlp")(h)
@@ -306,13 +336,15 @@ class _LayerStep(nn.Module):
     layer_cls: Any = None  # default LlamaDecoderLayer (set below)
 
     @nn.compact
-    def __call__(self, x, rope):
+    def __call__(self, x, rope, chunk_ctx=None):
         cfg = self.config
         cls = self.layer_cls or LlamaDecoderLayer
         policy = _remat_policy(cfg.remat_policy)
         if policy is not None:
             cls = nn.remat(cls, policy=policy, prevent_cse=False)
-        return cls(cfg, name="block")(x, rope), None
+        if chunk_ctx is None:  # 2-arg layer variants (Mixtral) stay compatible
+            return cls(cfg, name="block")(x, rope), None
+        return cls(cfg, name="block")(x, rope, chunk_ctx), None
 
 
 class LlamaModel(nn.Module):
@@ -344,7 +376,7 @@ class LlamaModel(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
         )
 
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
+    def __call__(self, input_ids: jax.Array, chunk_ctx=None) -> jax.Array:
         cfg = self.config
         if input_ids.shape[1] > cfg.max_seq_len:
             raise ValueError(
@@ -355,7 +387,10 @@ class LlamaModel(nn.Module):
         # cos/sin computed ONCE here (not per scanned layer) and broadcast
         rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
         x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
-        x, _ = self.layers(x, rope)
+        if chunk_ctx is None:
+            x, _ = self.layers(x, rope)
+        else:
+            x, _ = self.layers(x, rope, chunk_ctx)
         return self.final_norm(x)
 
     def attend(self, x: jax.Array) -> jax.Array:

@@ -1,0 +1,60 @@
+"""Offline ZeRO checkpoint conversion CLI (reference
+``optimizer/convert_zero_checkpoints.py`` — ``merge_optim_dp_checkpoints``:54,
+``split_and_save_ckpts``:102, ``main``:176; console script
+``nxd_convert_zero_checkpoints``).
+
+The reference's job — merge per-DP-rank optimizer shards into a full state
+and re-split for a new DP degree — mostly DISSOLVES here: checkpoints store
+GLOBAL logical arrays (orbax/tensorstore), so loading under any mesh/degree
+reshards automatically (``load_checkpoint(target=...)``,
+``tests/test_checkpoint.py::test_reshard_on_load``). What remains real for
+an offline tool:
+
+* consolidating a tagged ``TrainState`` checkpoint into a plain,
+  mesh-agnostic array tree (e.g. to hand weights to evaluation or the HF
+  exporter) — ``--params-only``;
+* re-writing a checkpoint to another location/storage (fs <-> object store)
+  without bringing up a training job.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from neuronx_distributed_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+
+def convert(input_dir: str, output_dir: str, tag: Optional[str] = None,
+            out_tag: Optional[str] = None, params_only: bool = False) -> str:
+    """Load ``input_dir[/tag]`` and re-save to ``output_dir`` (different
+    storage backend allowed). Returns the tag written."""
+    state, user_content = load_checkpoint(input_dir, tag=tag)
+    if params_only:
+        if isinstance(state, dict) and "params" in state:
+            state = state["params"]
+        else:
+            raise ValueError(
+                "checkpoint has no 'params' entry — is this a TrainState tag?"
+            )
+    out_tag = out_tag or tag or "converted"
+    save_checkpoint(output_dir, out_tag, state, user_content=user_content)
+    return out_tag
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--input", required=True, help="source checkpoint dir/URL")
+    p.add_argument("--output", required=True, help="destination dir/URL")
+    p.add_argument("--tag", default=None, help="source tag (default: newest)")
+    p.add_argument("--out_tag", default=None, help="destination tag")
+    p.add_argument("--params-only", action="store_true",
+                   help="strip optimizer state: write only the param tree")
+    args = p.parse_args(argv)
+    tag = convert(args.input, args.output, args.tag, args.out_tag,
+                  args.params_only)
+    print(f"wrote {args.output}/{tag}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Channel-parallel 2D convolutions (reference ``parallel_layers/layers.py``
+— ``OutputChannelParallelConv2d``:1033, ``InputChannelParallelConv2d``:1134,
+``Conv2dWithInputGradAllReduce``:813).
+
+Same GSPMD treatment as the linear layers: the kernel's channel dim is
+*declared* sharded and XLA emits the collectives — the output-channel conv
+shards the filter bank (embarrassingly parallel), the input-channel conv
+contracts over a sharded dim (partial sums all-reduced, or left sharded for
+a following input-parallel layer). NHWC layout (TPU-native; the reference is
+NCHW torch)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.layers import default_kernel_init
+from neuronx_distributed_tpu.parallel.mesh import DP_AXES, TP_AXIS
+from neuronx_distributed_tpu.parallel.partitioning import constrain
+
+Dtype = Any
+
+# activation layouts: (batch, h, w, channels)
+_ACT_FULL = P(DP_AXES, None, None, None)
+_ACT_CP = P(DP_AXES, None, None, TP_AXIS)   # channel-sharded activations
+
+
+def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)  # type: ignore[return-value]
+
+
+class OutputChannelParallelConv2d(nn.Module):
+    """Conv with OUTPUT channels sharded over TP (reference layers.py:1033).
+    ``gather_output=False`` leaves the activation channel-sharded for a
+    following :class:`InputChannelParallelConv2d`."""
+
+    features: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    strides: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    use_bias: bool = True
+    gather_output: bool = False
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = default_kernel_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = _pair(self.kernel_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, None, None, TP_AXIS)),
+            (kh, kw, x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.with_partitioning(nn.initializers.zeros_init(), (TP_AXIS,)),
+                (self.features,), self.param_dtype,
+            )
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=_pair(self.strides), padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return constrain(y, _ACT_FULL if self.gather_output else _ACT_CP)
+
+
+class InputChannelParallelConv2d(nn.Module):
+    """Conv with INPUT channels sharded over TP (reference layers.py:1134).
+    Partial sums over the sharded contraction are all-reduced by GSPMD
+    (the reference's explicit ``reduce_from_tensor_model_parallel_region``)."""
+
+    features: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    strides: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = default_kernel_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = _pair(self.kernel_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, None, TP_AXIS, None)),
+            (kh, kw, x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = None
+        if self.use_bias:
+            # replicated; added once after the reduction (reference :1205)
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.features,), self.param_dtype)
+        if self.input_is_parallel:
+            x = constrain(x, _ACT_CP)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=_pair(self.strides), padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = constrain(y, _ACT_FULL)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y

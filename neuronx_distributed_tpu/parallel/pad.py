@@ -1,0 +1,76 @@
+"""Attention-head padding so ``num_heads % tp == 0`` (reference
+``parallel_layers/pad.py`` — ``get_number_of_extra_heads``:10,
+``pad_model``:28; used for inference when a model's head count doesn't
+divide the TP degree).
+
+The reference walks nn.Modules and zero-pads their weight tensors in place.
+Functionally here: :func:`pad_llama_heads` returns a new param tree + config
+with ``extra`` zero query heads appended. Exactness argument (same as the
+reference's): padded Q heads produce garbage attention outputs, but the
+o_proj rows for those heads are zero, so the projected output — and every
+logit — is bit-identical to the unpadded model."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def get_number_of_extra_heads(num_heads: int, tp_degree: int) -> int:
+    """Heads to add so tp divides the total (reference pad.py:10)."""
+    return (-num_heads) % tp_degree
+
+
+def pad_llama_heads(params: PyTree, config, tp_degree: int) -> Tuple[PyTree, Any]:
+    """Zero-pad query heads of a Llama-family param tree (stacked or not) to
+    the next multiple of ``tp_degree``; returns ``(padded_params,
+    padded_config)``. KV heads are NOT padded — non-dividing KV counts use
+    ``kv_size_multiplier`` replication (reference qkv_linear.py:34-78), which
+    composes with this."""
+    extra = get_number_of_extra_heads(config.num_heads, tp_degree)
+    if extra == 0:
+        return params, config
+    n, d = config.num_heads, config.head_dim_
+    mha = config.num_kv_heads == config.num_heads
+    if not mha:
+        # appending Q heads changes n//n_kv, so EXISTING heads would be
+        # regrouped onto the wrong KV heads — silently wrong outputs. GQA
+        # models make their heads divide tp via kv_size_multiplier instead
+        # (reference qkv_linear.py:34-78).
+        raise ValueError(
+            f"head padding supports MHA only (num_kv_heads == num_heads); "
+            f"got {config.num_kv_heads} != {config.num_heads} — use "
+            f"kv_size_multiplier for GQA"
+        )
+
+    def pad_leaf(path, leaf):
+        pstr = jax.tree_util.keystr(path)
+        # MHA pads K/V alongside Q (reference pads the whole attention);
+        # padded KV heads are zero -> uniform softmax over zero values -> 0,
+        # and the o_proj rows are zero regardless
+        q_like = ("['q_kernel']",) + ((("['k_kernel']", "['v_kernel']")) if mha else ())
+        if pstr.endswith(q_like):
+            # (..., H, N, D) -> (..., H, N+extra, D)
+            pad = [(0, 0)] * (leaf.ndim - 2) + [(0, extra), (0, 0)]
+            return jnp.pad(leaf, pad)
+        if "o_proj" in pstr and pstr.endswith("['kernel']"):
+            # (..., N*D, H) -> (..., (N+extra)*D, H): zero ROWS for new heads
+            lead = leaf.shape[:-2]
+            rows = leaf.reshape(*lead, n, d, leaf.shape[-1])
+            pad = [(0, 0)] * (rows.ndim - 3) + [(0, extra), (0, 0), (0, 0)]
+            rows = jnp.pad(rows, pad)
+            return rows.reshape(*lead, (n + extra) * d, leaf.shape[-1])
+        return leaf
+
+    padded = jax.tree_util.tree_map_with_path(pad_leaf, params)
+    # head_dim must stay explicit: hidden_size//num_heads no longer equals it
+    new_cfg = dataclasses.replace(
+        config, num_heads=n + extra, head_dim=d,
+        num_kv_heads=config.num_kv_heads + (extra if mha else 0),
+    )
+    return padded, new_cfg

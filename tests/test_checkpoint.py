@@ -251,3 +251,22 @@ def test_resume_exactly_reproduces_straight_run(tmp_path):
         state2, _ = step(state2, batches[i], jax.random.key(i))
     resumed = jax.tree.map(np.asarray, state2.params)
     jax.tree.map(np.testing.assert_array_equal, straight, resumed)
+
+
+def test_convert_zero_checkpoints_cli(tmp_path):
+    """Offline converter: TrainState tag -> params-only tree at a new
+    location (incl. crossing storage backends: fs -> object-store URL)."""
+    from neuronx_distributed_tpu.optimizer import convert_zero_checkpoints as czc
+
+    state = {"step": np.int32(3),
+             "params": {"w": np.arange(6, dtype=np.float32)},
+             "opt_state": {"mu": np.zeros(6, np.float32)}}
+    src = str(tmp_path / "src")
+    ckpt.save_checkpoint(src, "step_3", state, user_content={"step": 3})
+    dst = "file://" + str(tmp_path / "dst")
+    czc.main(["--input", src, "--output", dst, "--params-only",
+              "--out_tag", "weights"])
+    restored, uc = ckpt.load_checkpoint(dst, "weights")
+    assert set(restored.keys()) == {"w"}
+    np.testing.assert_array_equal(restored["w"], np.arange(6, dtype=np.float32))
+    assert uc == {"step": 3}

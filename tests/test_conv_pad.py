@@ -1,0 +1,113 @@
+"""Channel-parallel Conv2d + head-padding tests (reference
+``layers.py:1033,1134`` conv goldens and ``pad.py`` semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.conv import (
+    InputChannelParallelConv2d,
+    OutputChannelParallelConv2d,
+)
+
+
+def test_conv_pair_tp_matches_dense():
+    """Output-parallel conv -> input-parallel conv under TP4 == dense."""
+    from flax import linen as nn
+    from flax.core import meta
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = OutputChannelParallelConv2d(16, kernel_size=3, name="c1")(x)
+            h = nn.relu(h)
+            return InputChannelParallelConv2d(8, kernel_size=3, name="c2")(h)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
+    m = Block()
+    variables = m.init(jax.random.PRNGKey(1), x)
+    dense = meta.unbox(variables)
+    golden = m.apply(dense, x)
+
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    from neuronx_distributed_tpu.parallel.partitioning import named_sharding_tree
+
+    sharded = jax.device_put(dense, named_sharding_tree(variables, st.mesh))
+    with jax.set_mesh(st.mesh):
+        out = jax.jit(m.apply)(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
+def test_conv_gather_output_and_strides():
+    from flax.core import meta
+
+    m = OutputChannelParallelConv2d(6, kernel_size=2, strides=2, padding="VALID",
+                                    gather_output=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 3))
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(meta.unbox(variables), x)
+    assert y.shape == (1, 4, 4, 6)
+
+
+# --- head padding ----------------------------------------------------------
+
+def test_extra_heads_math():
+    from neuronx_distributed_tpu.parallel.pad import get_number_of_extra_heads
+
+    assert get_number_of_extra_heads(12, 8) == 4
+    assert get_number_of_extra_heads(16, 8) == 0
+    assert get_number_of_extra_heads(5, 4) == 3
+
+
+def test_pad_llama_heads_exact_mha():
+    """Padded-MHA model logits == unpadded logits (zero o_proj rows make the
+    extra heads inert — the reference's pad_model invariant)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel.pad import pad_llama_heads
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=30, intermediate_size=32,
+                      num_layers=2, num_heads=5, num_kv_heads=5, head_dim=6,
+                      max_seq_len=32, dtype=jnp.float32,
+                      use_flash_attention=False, remat_policy=None)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 63)
+    model = LlamaForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(1), ids))["params"]
+    golden = model.apply({"params": params}, ids)
+
+    padded, pcfg = pad_llama_heads(params, cfg, tp_degree=4)
+    assert pcfg.num_heads == 8 and pcfg.num_kv_heads == 8 and pcfg.head_dim_ == 6
+    q = padded["model"]["layers"]["block"]["attention"]["qkv"]["q_kernel"]
+    assert q.shape[-2] == 8
+    out = LlamaForCausalLM(pcfg).apply({"params": padded}, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=1e-5, atol=1e-6)
+
+    # and it actually runs TP4-sharded (5 heads couldn't)
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    pmodel = LlamaForCausalLM(pcfg)
+    variables = jax.eval_shape(lambda: pmodel.init(jax.random.PRNGKey(1), ids))
+    from neuronx_distributed_tpu.parallel.partitioning import named_sharding_tree
+
+    sharded = jax.device_put({"params": padded},
+                             named_sharding_tree(variables, st.mesh))
+    with jax.set_mesh(st.mesh):
+        out_tp = jax.jit(pmodel.apply)(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out_tp), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pad_rejects_gqa():
+    """Appending Q heads changes the q-to-kv grouping ratio, so GQA padding
+    would silently remap existing heads to wrong KV heads (confirmed
+    numerically in review) — it must raise, pointing at kv_size_multiplier."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+    from neuronx_distributed_tpu.parallel.pad import pad_llama_heads
+
+    for n, kv in ((10, 3), (6, 2)):
+        cfg = LlamaConfig(num_heads=n, num_kv_heads=kv, head_dim=4)
+        with pytest.raises(ValueError, match="kv_size_multiplier"):
+            pad_llama_heads({}, cfg, tp_degree=4)

@@ -206,3 +206,98 @@ def test_generate_flash_prefill_end_to_end():
         lm = CausalLM(c, params, LlamaForCausalLM, buckets=(192,), max_batch=2)
         out[name] = lm.generate(prompts, max_new_tokens=4).tokens
     np.testing.assert_array_equal(out["dense"], out["flash"])
+
+
+# --- Medusa tree decoding + speculative v2 ---------------------------------
+
+def _medusa_setup():
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.medusa import MedusaLlamaForCausalLM
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=128,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, 127),
+                     np.int32)
+    import dataclasses
+
+    mm = MedusaLlamaForCausalLM(dataclasses.replace(cfg, decode=True),
+                                num_medusa_heads=2)
+    mparams = meta.unbox(mm.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
+    return cfg, ids, mparams
+
+
+def test_medusa_buffers_structure():
+    from neuronx_distributed_tpu.inference.medusa import generate_medusa_buffers
+
+    b = generate_medusa_buffers([(0,), (1,), (0, 0), (0, 1), (1, 0)])
+    assert b["num_nodes"] == 6 and b["depth"] == 2
+    # every node attends root and itself; (0,0) attends (0,) but not (1,)
+    assert b["attn_mask"][:, 0].all()
+    assert b["attn_mask"][3, 1] and not b["attn_mask"][3, 2]
+    # depth-2 nodes index into head-1's pool (offset 1 + TOPK)
+    assert b["tree_indices"][3] == 11
+    assert list(b["position_ids"]) == [0, 1, 1, 2, 2, 2]
+    assert b["retrieve_indices"].shape == (3, 3)  # three maximal paths
+
+
+def test_medusa_matches_greedy_exactly():
+    """The Medusa invariant: tree decoding with ANY head quality (here
+    random heads) emits exactly the base model's greedy continuation —
+    acceptance verifies every token against the verifier's argmax."""
+    from neuronx_distributed_tpu.inference.medusa import medusa_generate
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    cfg, ids, mparams = _medusa_setup()
+    base_params = {k: v for k, v in mparams.items() if not k.startswith("medusa")}
+    lm = CausalLM(cfg, base_params, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    golden = lm.generate(ids, max_new_tokens=12)
+    res = medusa_generate(cfg, mparams, ids, max_new_tokens=12,
+                          num_medusa_heads=2,
+                          medusa_choices=[(0,), (1,), (0, 0), (0, 1), (1, 0)])
+    assert golden.tokens[0].tolist() == res.tokens[0].tolist()
+
+
+def test_medusa_eos_stops():
+    from neuronx_distributed_tpu.inference.medusa import medusa_generate
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    cfg, ids, mparams = _medusa_setup()
+    base_params = {k: v for k, v in mparams.items() if not k.startswith("medusa")}
+    lm = CausalLM(cfg, base_params, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    golden = lm.generate(ids, max_new_tokens=12)
+    eos = int(golden.tokens[0, 4])  # force a stop mid-stream
+    res = medusa_generate(cfg, mparams, ids, max_new_tokens=12,
+                          num_medusa_heads=2, eos_token_id=eos)
+    n = int(res.lengths[0])
+    assert res.tokens[0, n - 1] == eos
+    assert (res.tokens[0, n:] == 0).all()
+
+
+def test_speculative_sampling_acceptance_identical_models():
+    """draft == target -> acceptance prob min(1, p/p) = 1: every proposal
+    accepted, output length always fills, tokens valid. (The distributional
+    guarantee of speculative sampling degenerates to 'sample from target'.)"""
+    from neuronx_distributed_tpu.inference.speculative import speculative_generate
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=128,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, 127),
+                     np.int32)
+    model = LlamaForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
+    target = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    draft = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    res = speculative_generate(target, draft, ids, 10, num_draft=3,
+                               greedy=False, temperature=0.8,
+                               rng=jax.random.key(3))
+    assert int(res.lengths[0]) == 10
+    assert (res.tokens[0] >= 0).all() and (res.tokens[0] < 128).all()

@@ -60,15 +60,24 @@ class MedusaLlamaForCausalLM(nn.Module):
     num_medusa_heads: int = 2
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, chunk_ctx=None):
+    def __call__(self, input_ids: jax.Array, chunk_ctx=None, heads: bool = True):
+        """``heads=False`` skips the medusa-head projections — the tree
+        VERIFY forward only needs base logits; computing H extra vocab
+        projections over every tree node there is pure waste."""
         cfg = self.config
-        x = LlamaModel(cfg, name="model")(input_ids, chunk_ctx)
+        model = LlamaModel(cfg, name="model")
+        x = model(input_ids, chunk_ctx)
         if cfg.sequence_parallel:
             x = constrain(x, ACT_FULL)
-        logits = ColumnParallelLinear(
-            cfg.vocab_size, use_bias=False, gather_output=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
-        )(x)
+        if cfg.tie_word_embeddings:  # same head handling as LlamaForCausalLM
+            logits = model.attend(x)
+        else:
+            logits = ColumnParallelLinear(
+                cfg.vocab_size, use_bias=False, gather_output=False,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+            )(x)
+        if not heads:
+            return logits, None
         med = []
         for i in range(self.num_medusa_heads):
             r = x + nn.silu(nn.Dense(
@@ -217,9 +226,9 @@ def medusa_generate(
     # the speculative proposer): the KV cache is the dominant allocation
     @partial(jax.jit, donate_argnums=(1,))
     def tree_step(params, cache, tree_tokens):
-        (logits, med), mut = model.apply(
+        (logits, _), mut = model.apply(
             {"params": params, "cache": cache}, tree_tokens,
-            (chunk_mask, chunk_pos), mutable=["cache"],
+            (chunk_mask, chunk_pos), heads=False, mutable=["cache"],
         )
         return logits, mut["cache"]
 

@@ -1,0 +1,29 @@
+"""High-level trainer integration (reference ``lightning/`` — the PyTorch
+Lightning plugin set: ``NeuronXLAStrategy`` strategy.py:31, ``NeuronLTModule``
+module.py:24, ``NeuronTensorBoardLogger`` logger.py:24, checkpoint-io,
+launcher, progress bar — ~1.1k LoC; SURVEY §1 L7).
+
+TPU-native re-design: there is no PTL dependency to plug into — the
+capability the reference's plugin set delivers (subclass a module, get a
+managed fit loop with parallel init, ZeRO-1, rank-aware logging, checkpoint
+IO, resume, callbacks) is provided directly:
+
+* :class:`NxDLightningModule` — the ``NeuronLTModule`` counterpart: declares
+  the model, the loss, and optimizer settings;
+* :class:`NxDTrainer` — strategy+launcher+loop in one: initializes parallel
+  state from the nxd config (the strategy's ``setup_distributed``), builds
+  the sharded model/optimizer/state, runs fit with grad accumulation,
+  validation, resume, callbacks;
+* :class:`TensorBoardLogger` / :class:`JsonLogger` — rank0-gated metric
+  sinks (the reference logs on last-PP/first-DP/first-TP rank only);
+* callbacks: :class:`ModelCheckpoint`, :class:`ProgressLogger`.
+"""
+
+from neuronx_distributed_tpu.lightning.callbacks import (  # noqa: F401
+    Callback,
+    ModelCheckpoint,
+    ProgressLogger,
+)
+from neuronx_distributed_tpu.lightning.loggers import JsonLogger, TensorBoardLogger  # noqa: F401
+from neuronx_distributed_tpu.lightning.module import NxDLightningModule  # noqa: F401
+from neuronx_distributed_tpu.lightning.trainer import NxDTrainer  # noqa: F401

@@ -1,0 +1,76 @@
+"""Trainer callbacks (reference ``lightning/`` progress bar + PTL's
+checkpoint callback role, re-homed onto the in-repo checkpoint core)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from neuronx_distributed_tpu.utils import get_logger
+
+logger = get_logger("nxd.lightning")
+
+
+class Callback:
+    def on_train_start(self, trainer, module) -> None:
+        pass
+
+    def on_step_end(self, trainer, module, step: int,
+                    metrics: Dict[str, Any]) -> None:
+        pass
+
+    def on_validation_end(self, trainer, module, step: int,
+                          metrics: Dict[str, Any]) -> None:
+        pass
+
+    def on_train_end(self, trainer, module) -> None:
+        pass
+
+
+class ModelCheckpoint(Callback):
+    """Periodic checkpointing through the tagged async checkpoint core
+    (reference ``NeuronCheckpointIO``, lightning/checkpoint_io.py:13)."""
+
+    def __init__(self, checkpoint_dir: str, every_n_steps: int = 100,
+                 num_kept: int = 3, async_save: bool = True):
+        self.checkpoint_dir = checkpoint_dir
+        self.every_n_steps = every_n_steps
+        self.num_kept = num_kept
+        self.async_save = async_save
+
+    def on_step_end(self, trainer, module, step, metrics) -> None:
+        if step % self.every_n_steps == 0:
+            self._save(trainer, step)
+
+    def on_train_end(self, trainer, module) -> None:
+        from neuronx_distributed_tpu.checkpoint import finalize_checkpoint
+
+        self._save(trainer, int(trainer.state.step))
+        finalize_checkpoint()
+
+    def _save(self, trainer, step: int) -> None:
+        from neuronx_distributed_tpu.checkpoint import save_checkpoint
+
+        save_checkpoint(self.checkpoint_dir, f"step_{step}", trainer.state,
+                        user_content={"step": step}, async_save=self.async_save,
+                        num_kept=self.num_kept)
+
+
+class ProgressLogger(Callback):
+    """Rank0 textual progress (reference lightning/progress_bar.py — a TTY
+    bar makes no sense for multi-host batch jobs; the reference also gates
+    it down to plain prints on non-interactive ranks)."""
+
+    def __init__(self, every_n_steps: int = 10):
+        self.every_n_steps = every_n_steps
+
+    def on_step_end(self, trainer, module, step, metrics) -> None:
+        if step % self.every_n_steps == 0:
+            parts = " ".join(
+                f"{k}={float(v):.4f}" for k, v in metrics.items()
+                if hasattr(v, "__float__")
+            )
+            logger.info("step %d/%d %s", step, trainer.max_steps, parts)
+
+    def on_validation_end(self, trainer, module, step, metrics) -> None:
+        parts = " ".join(f"{k}={float(v):.4f}" for k, v in metrics.items())
+        logger.info("validation @%d %s", step, parts)

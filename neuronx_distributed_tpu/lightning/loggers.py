@@ -1,0 +1,96 @@
+"""Metric loggers (reference ``lightning/logger.py``
+``NeuronTensorBoardLogger``:24 — TB scalars emitted only on the logging rank).
+
+On a single-controller JAX job the logging-rank predicate collapses to
+``jax.process_index() == 0`` (the reference gates on last-PP/first-DP/
+first-TP because every torch rank runs the script; here one process drives
+all devices per host). TensorBoard writing uses torch's bundled
+``SummaryWriter`` when importable and falls back to line-delimited JSON —
+the fallback keeps hermetic environments working.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def _is_logging_process() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class BaseLogger:
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        pass
+
+
+class JsonLogger(BaseLogger):
+    """Line-delimited JSON metrics (always available)."""
+
+    def __init__(self, log_dir: str, name: str = "metrics"):
+        self.enabled = _is_logging_process()
+        self.path = os.path.join(log_dir, f"{name}.jsonl")
+        self._fh = None
+        if self.enabled:
+            os.makedirs(log_dir, exist_ok=True)
+            self._fh = open(self.path, "a")
+
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        if self._fh is None:
+            return
+        rec = {"step": step, "time": time.time()}
+        rec.update({k: float(v) if hasattr(v, "__float__") else v
+                    for k, v in metrics.items()})
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def finalize(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardLogger(BaseLogger):
+    """TB scalars on the logging process (reference logger.py:24-139);
+    transparently degrades to :class:`JsonLogger` when no SummaryWriter
+    implementation is importable."""
+
+    def __init__(self, log_dir: str, name: str = "nxd"):
+        self.enabled = _is_logging_process()
+        self._writer = None
+        self._fallback: Optional[JsonLogger] = None
+        if not self.enabled:
+            return
+        path = os.path.join(log_dir, name)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=path)
+        except Exception:
+            self._fallback = JsonLogger(path)
+
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        if not self.enabled:
+            return
+        if self._writer is not None:
+            for k, v in metrics.items():
+                if hasattr(v, "__float__"):
+                    self._writer.add_scalar(k, float(v), step)
+        elif self._fallback is not None:
+            self._fallback.log_metrics(metrics, step)
+
+    def finalize(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        if self._fallback is not None:
+            self._fallback.finalize()

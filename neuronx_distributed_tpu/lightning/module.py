@@ -1,0 +1,72 @@
+"""User module base (reference ``lightning/module.py`` ``NeuronLTModule``:24).
+
+The reference's module carries (model_fn, opt_cls, scheduler_cls, args/kwargs,
+grad_accum_steps, logging knobs) and wires them into PTL hooks. Functionally:
+subclass and implement :meth:`configure_model`, :meth:`model_inputs` and
+:meth:`training_loss`; override the others as needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import optax
+from flax import linen as nn
+
+PyTree = Any
+Batch = Dict[str, Any]
+
+
+class NxDLightningModule:
+    """Declarative training recipe consumed by :class:`NxDTrainer`."""
+
+    def __init__(
+        self,
+        nxd_config: Dict[str, Any],
+        learning_rate: Any = 1e-4,
+        weight_decay: float = 0.01,
+        grad_accum_steps: int = 1,
+    ):
+        self.nxd_config = nxd_config
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.grad_accum_steps = int(grad_accum_steps)
+
+    # --- required hooks --------------------------------------------------
+
+    def configure_model(self) -> nn.Module:
+        """Build the flax module (reference ``model_fn``)."""
+        raise NotImplementedError
+
+    def model_inputs(self, batch: Batch):
+        """Positional example args for ``module.init`` from a batch
+        (shape-only; used once for sharded initialization)."""
+        raise NotImplementedError
+
+    def training_loss(self, model, params: PyTree, batch: Batch,
+                      rng: jax.Array) -> jax.Array:
+        """Scalar loss (reference ``training_step``). ``model`` is the
+        trainer's ``ParallelModel``; call ``model.module.apply`` inside."""
+        raise NotImplementedError
+
+    # --- optional hooks --------------------------------------------------
+
+    def validation_loss(self, model, params: PyTree, batch: Batch,
+                        rng: jax.Array) -> jax.Array:
+        return self.training_loss(model, params, batch, rng)
+
+    def configure_optimizer(self, model):
+        """Return the NxDOptimizer (reference ``configure_optimizers``);
+        default: the trainer factory with this module's lr/wd, wrapped in
+        ``optax.MultiSteps`` when ``grad_accum_steps > 1`` (the reference
+        plumbs grad_accum through its manual-optimization loop)."""
+        from neuronx_distributed_tpu.trainer import initialize_parallel_optimizer
+
+        opt = initialize_parallel_optimizer(
+            self.nxd_config, model,
+            learning_rate=self.learning_rate, weight_decay=self.weight_decay,
+        )
+        if self.grad_accum_steps > 1:
+            opt.tx = optax.MultiSteps(opt.tx, every_k_schedule=self.grad_accum_steps)
+        return opt

@@ -1,0 +1,128 @@
+"""The managed fit loop (reference ``lightning/strategy.py``
+``NeuronXLAStrategy``:31 + launcher + PTL's Trainer role).
+
+The strategy's jobs — distributed init from the nxd config
+(``setup_distributed``:86), checkpoint IO, sharded-checkpoint paths, loop
+orchestration — are one class here; there is no separate launcher because
+JAX is single-controller (processes are started by the cluster runtime, not
+forked per device).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from neuronx_distributed_tpu.checkpoint import has_checkpoint, load_checkpoint
+from neuronx_distributed_tpu.lightning.callbacks import Callback
+from neuronx_distributed_tpu.lightning.loggers import BaseLogger
+from neuronx_distributed_tpu.lightning.module import NxDLightningModule
+from neuronx_distributed_tpu.trainer import (
+    create_train_state,
+    initialize_parallel_model,
+    make_train_step,
+)
+from neuronx_distributed_tpu.utils import get_logger
+from neuronx_distributed_tpu.utils.profiler import step_annotation
+
+logger = get_logger("nxd.lightning")
+
+
+class NxDTrainer:
+    """fit() = parallel init → sharded model/opt/state → resume → loop with
+    callbacks, validation, and logging."""
+
+    def __init__(
+        self,
+        max_steps: int,
+        callbacks: Sequence[Callback] = (),
+        logger_: Optional[BaseLogger] = None,
+        val_every_n_steps: int = 0,
+        val_steps: int = 1,
+        checkpoint_dir: Optional[str] = None,
+        seed: int = 0,
+    ):
+        self.max_steps = int(max_steps)
+        self.callbacks = list(callbacks)
+        self.logger = logger_
+        self.val_every_n_steps = int(val_every_n_steps)
+        self.val_steps = int(val_steps)
+        self.checkpoint_dir = checkpoint_dir
+        self.seed = seed
+        self.model = None
+        self.optimizer = None
+        self.state = None
+
+    # --- loop ------------------------------------------------------------
+
+    def fit(
+        self,
+        module: NxDLightningModule,
+        train_batches: Iterator[Dict[str, Any]],
+        val_batches: Optional[Iterator[Dict[str, Any]]] = None,
+    ):
+        sample = next(train_batches)
+        self.model = initialize_parallel_model(
+            module.nxd_config, module.configure_model, *module.model_inputs(sample)
+        )
+        self.optimizer = module.configure_optimizer(self.model)
+        self.state = create_train_state(self.model, self.optimizer)
+        if self.checkpoint_dir and has_checkpoint(self.checkpoint_dir):
+            self.state, content = load_checkpoint(self.checkpoint_dir,
+                                                  target=self.state)
+            logger.info("resumed at step %s", (content or {}).get("step"))
+
+        def loss_fn(params, batch, rng):
+            return module.training_loss(self.model, params, batch, rng)
+
+        step_fn = make_train_step(self.model, self.optimizer, loss_fn)
+        val_fn = None
+        if val_batches is not None:
+            val_fn = jax.jit(
+                lambda params, batch, rng: module.validation_loss(
+                    self.model, params, batch, rng)
+            )
+
+        for cb in self.callbacks:
+            cb.on_train_start(self, module)
+        metrics: Dict[str, Any] = {}
+        start = int(self.state.step)
+        # Batch alignment: step i+1 trains the stream's i-th batch. The init
+        # sample IS batch 0 (re-queued on fresh runs); a resumed run must
+        # skip forward so global step <-> batch pairing matches a straight
+        # run exactly (assumes a restartable deterministic stream, like the
+        # reference's set_seed + sampler-state discipline).
+        pending: Optional[Dict[str, Any]] = sample if start == 0 else None
+        for _ in range(max(start - 1, 0)):
+            next(train_batches)
+        for i in range(start, self.max_steps):
+            batch = pending if pending is not None else next(train_batches)
+            pending = None
+            with step_annotation(i):
+                self.state, metrics = step_fn(self.state, batch,
+                                              jax.random.key(self.seed + i + 1))
+            step = i + 1
+            if self.logger is not None:
+                self.logger.log_metrics(metrics, step)
+            for cb in self.callbacks:
+                cb.on_step_end(self, module, step, metrics)
+            if val_fn is not None and self.val_every_n_steps and (
+                step % self.val_every_n_steps == 0 or step == self.max_steps
+            ):
+                losses = [
+                    float(val_fn(self.state.params, next(val_batches),
+                                 jax.random.key(step)))
+                    for _ in range(self.val_steps)
+                ]
+                val_metrics = {"val_loss": float(np.mean(losses))}
+                if self.logger is not None:
+                    self.logger.log_metrics(val_metrics, step)
+                for cb in self.callbacks:
+                    cb.on_validation_end(self, module, step, val_metrics)
+        for cb in self.callbacks:
+            cb.on_train_end(self, module)
+        if self.logger is not None:
+            self.logger.finalize()
+        return self.state, metrics

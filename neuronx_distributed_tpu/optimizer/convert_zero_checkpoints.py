@@ -22,13 +22,15 @@ from __future__ import annotations
 import argparse
 from typing import Optional
 
-from neuronx_distributed_tpu.checkpoint import load_checkpoint, save_checkpoint
+from neuronx_distributed_tpu.checkpoint import latest_tag, load_checkpoint, save_checkpoint
 
 
 def convert(input_dir: str, output_dir: str, tag: Optional[str] = None,
             out_tag: Optional[str] = None, params_only: bool = False) -> str:
     """Load ``input_dir[/tag]`` and re-save to ``output_dir`` (different
     storage backend allowed). Returns the tag written."""
+    if tag is None:
+        tag = latest_tag(input_dir)  # keep the step identity in the output
     state, user_content = load_checkpoint(input_dir, tag=tag)
     if params_only:
         if isinstance(state, dict) and "params" in state:

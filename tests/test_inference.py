@@ -301,3 +301,33 @@ def test_speculative_sampling_acceptance_identical_models():
                                rng=jax.random.key(3))
     assert int(res.lengths[0]) == 10
     assert (res.tokens[0] >= 0).all() and (res.tokens[0] < 128).all()
+
+
+def test_medusa_tied_embeddings():
+    """Tied configs must route the base logits through the embedding table
+    exactly like LlamaForCausalLM (r2 review)."""
+    import dataclasses
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.medusa import (
+        MedusaLlamaForCausalLM,
+        medusa_generate,
+    )
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=128,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None, tie_word_embeddings=True)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, 127),
+                     np.int32)
+    mm = MedusaLlamaForCausalLM(dataclasses.replace(cfg, decode=True),
+                                num_medusa_heads=2)
+    mparams = meta.unbox(mm.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
+    assert "lm_head" not in mparams
+    base_params = {k: v for k, v in mparams.items() if not k.startswith("medusa")}
+    lm = CausalLM(cfg, base_params, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    golden = lm.generate(ids, max_new_tokens=8)
+    res = medusa_generate(cfg, mparams, ids, max_new_tokens=8, num_medusa_heads=2)
+    assert golden.tokens[0].tolist() == res.tokens[0].tolist()

@@ -45,7 +45,7 @@ def model_flops_per_step(layers, batch, seq, hidden, intermediate, vocab, n_head
     return mm + 3.5 * attn_fwd
 
 
-def build_step(layers, batch, seq, on_tpu):
+def build_step(layers, batch, seq, on_tpu, remat_policy="attention"):
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from neuronx_distributed_tpu.parallel import mesh as ps
     from neuronx_distributed_tpu.trainer import (
@@ -71,7 +71,7 @@ def build_step(layers, batch, seq, on_tpu):
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_layers=layers, num_heads=32, num_kv_heads=32, max_seq_len=seq,
         dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, use_flash_attention=on_tpu,
-        attention_block_q=256, attention_block_k=512, remat_policy="attention",
+        remat_policy=remat_policy,  # blocks: seq-adaptive default
     ) if on_tpu else LlamaConfig(
         vocab_size=1024, hidden_size=256, intermediate_size=512,
         num_layers=layers, num_heads=8, num_kv_heads=8, max_seq_len=seq,
@@ -151,12 +151,12 @@ def bench_inference_ttft(prompt_len=2048, depths=(2, 6), trials=7, decode_steps=
             num_layers=layers, num_heads=40, num_kv_heads=40,
             max_seq_len=prompt_len + 512, dtype=jnp.bfloat16,
             param_dtype=jnp.bfloat16, use_flash_attention=True,
-            attention_block_q=256, attention_block_k=512, remat_policy=None,
+            remat_policy=None,  # blocks: seq-adaptive default
         )
         from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
 
         assert prompt_len >= 128 and flash_supported(
-            prompt_len, lcfg.max_seq_len, lcfg.attention_block_q, lcfg.attention_block_k
+            prompt_len, lcfg.max_seq_len, *lcfg.blocks_for(prompt_len)
         ), "TTFT config must exercise the flash-prefill path, not dense fallback"
         ids = jnp.zeros((1, 8), jnp.int32)
         model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg), ids)

@@ -52,7 +52,7 @@ def build_config(args) -> LlamaConfig:
         )
     return llama2_13b(
         max_seq_len=args.max_seq_len, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
-        remat_policy=None, attention_block_q=256, attention_block_k=512,
+        remat_policy=None,
     )
 
 

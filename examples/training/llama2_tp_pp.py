@@ -45,7 +45,7 @@ def build_config(args, seq: int) -> LlamaConfig:
         )
     return llama2_70b(
         max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
-        remat_policy="full", attention_block_q=256, attention_block_k=512,
+        remat_policy="full",
     )
 
 

@@ -44,7 +44,6 @@ def build_config(args, seq: int) -> LlamaConfig:
     return llama2_7b(
         max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
         sequence_parallel=True, remat_policy="attention",
-        attention_block_q=256, attention_block_k=512,
     )
 
 

@@ -46,7 +46,7 @@ def build_config(args, seq: int) -> MixtralConfig:
         )
     return mixtral_8x7b(
         max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
-        remat_policy="attention", attention_block_q=256, attention_block_k=512,
+        remat_policy="attention",
     )
 
 

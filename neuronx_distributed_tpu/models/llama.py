@@ -34,7 +34,10 @@ from neuronx_distributed_tpu.parallel.layers import (
     RMSNorm,
     RowParallelLinear,
 )
-from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy_mean
+from neuronx_distributed_tpu.parallel.loss import (
+    parallel_cross_entropy,
+    parallel_cross_entropy_mean,
+)
 from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, ACT_SP, constrain
 
 Dtype = Any
@@ -56,16 +59,42 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32   # storage dtype (master weights live in optimizer)
     sequence_parallel: bool = False
     use_flash_attention: bool = True
-    attention_block_q: int = 128
-    attention_block_k: int = 128
+    # None = sequence-adaptive choice (kernels.flash_attn.default_attention_blocks)
+    attention_block_q: Optional[int] = None
+    attention_block_k: Optional[int] = None
     remat_policy: Optional[str] = "full"  # None | "full" | "attention"
     kv_size_multiplier: int = 1
     tie_word_embeddings: bool = False
     decode: bool = False  # KV-cache inference mode (cache collection)
+    # CE loss sequence-chunking (long-seq memory lever): the head matmul +
+    # CE run per chunk of this many tokens when seq exceeds it (None = 4096)
+    loss_chunk_size: Optional[int] = None
 
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    def blocks_for(self, sq: int, sk: Optional[int] = None) -> Tuple[int, int]:
+        """Flash block sizes: explicit config values, else adaptive — block_q
+        keyed on the QUERY length, block_k on the KEY sweep length (``sk``;
+        a short prefill into a long cache still sweeps the whole cache).
+        Each block shrinks to a divisor of its sequence so the kernel's
+        divisibility constraint holds for lengths like 1280 or 4608; when no
+        >=128 divisor exists the caller's ``flash_supported`` guard routes
+        to the dense path."""
+        from neuronx_distributed_tpu.kernels.flash_attn import default_attention_blocks
+
+        sk = sk or sq
+        dq = self.attention_block_q or default_attention_blocks(sq)[0]
+        dk = self.attention_block_k or default_attention_blocks(sk)[1]
+
+        def shrink(b: int, s: int) -> int:
+            b = min(b, s)
+            while b > 128 and s % b:
+                b //= 2
+            return b
+
+        return shrink(dq, sq), shrink(dk, sk)
 
 
 # presets mirroring the reference's example configs (BASELINE.md ladder)
@@ -175,13 +204,17 @@ class LlamaAttention(nn.Module):
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
+        from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
+
+        s = x.shape[1]
+        blk_q, blk_k = cfg.blocks_for(s)
         # BSND -> BHSD for the kernel
         o = attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
             causal=True,
-            use_flash=cfg.use_flash_attention,
-            block_q=cfg.attention_block_q,
-            block_k=cfg.attention_block_k,
+            use_flash=cfg.use_flash_attention and flash_supported(s, s, blk_q, blk_k),
+            block_q=blk_q,
+            block_k=blk_k,
         )
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
         return self._o_proj(o)
@@ -253,11 +286,13 @@ class LlamaAttention(nn.Module):
         # (attention_base.py:103-114); short decode steps use the dense path.
         from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
 
-        blk_q = min(cfg.attention_block_q, s_new)
+        # block_k tiles the CACHE sweep (max_seq_len), not the query chunk
+        cfg_blk_q, cfg_blk_k = cfg.blocks_for(s_new, cfg.max_seq_len)
+        blk_q = min(cfg_blk_q, s_new)
         use_flash = (
             cfg.use_flash_attention
             and s_new >= 128
-            and flash_supported(s_new, cfg.max_seq_len, blk_q, cfg.attention_block_k)
+            and flash_supported(s_new, cfg.max_seq_len, blk_q, cfg_blk_k)
         )
         if use_flash:
             o = attention(
@@ -267,7 +302,7 @@ class LlamaAttention(nn.Module):
                 causal=False,
                 use_flash=True,
                 block_q=blk_q,
-                block_k=cfg.attention_block_k,
+                block_k=cfg_blk_k,
                 q_positions=positions,
                 kv_positions=None,  # default iota: j <= q position
             )
@@ -402,30 +437,72 @@ class LlamaForCausalLM(nn.Module):
     """Model + vocab-parallel LM head (tied to the embedding when
     ``config.tie_word_embeddings``). ``__call__`` returns (vocab-sharded)
     logits; ``loss`` computes the vocab-parallel CE without materializing
-    gathered logits (reference ``parallel_cross_entropy`` wiring)."""
+    gathered logits (reference ``parallel_cross_entropy`` wiring) — and at
+    long sequence, without materializing full-sequence logits at all: the
+    head matmul + CE run per sequence chunk under ``jax.checkpoint``, so
+    live logits are one chunk's (the (S, vocab) fp32 logit+grad buffers are
+    what OOM a 32k-seq step; the reference leans on Neuron runtime memory
+    there, SURVEY §5.7 memory levers)."""
 
     config: LlamaConfig
     layer_cls: Any = None  # decoder-block override (e.g. Mixtral's MoE layer)
 
-    @nn.compact
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
+    def setup(self):
         cfg = self.config
-        model = LlamaModel(cfg, self.layer_cls, name="model")
-        x = model(input_ids)
-        if cfg.sequence_parallel:
+        self.model = LlamaModel(cfg, self.layer_cls)
+        if not cfg.tie_word_embeddings:
+            # logits matmul runs in the compute dtype (bf16 MXU rate); the
+            # vocab-parallel CE upcasts to fp32 for the softmax/LSE math
+            # (parallel/loss.py) — fp32 here would force a slow fp32 matmul
+            # and materialize 4-byte logits for no numerical benefit
+            self.lm_head = ColumnParallelLinear(
+                cfg.vocab_size, use_bias=False, gather_output=False,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            )
+
+    def _head(self, x: jax.Array) -> jax.Array:
+        if self.config.tie_word_embeddings:
+            return self.model.attend(x)
+        return self.lm_head(x)
+
+    def _hidden(self, input_ids: jax.Array) -> jax.Array:
+        x = self.model(input_ids)
+        if self.config.sequence_parallel:
             x = constrain(x, ACT_FULL)
-        if cfg.tie_word_embeddings:
-            return model.attend(x)
-        # logits matmul runs in the compute dtype (bf16 MXU rate); the
-        # vocab-parallel CE upcasts to fp32 for the softmax/LSE math
-        # (parallel/loss.py) — fp32 here would force a slow fp32 matmul and
-        # materialize 4-byte logits for no numerical benefit in the loss
-        return ColumnParallelLinear(
-            cfg.vocab_size, use_bias=False, gather_output=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
-        )(x)
+        return x
+
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        return self._head(self._hidden(input_ids))
 
     def loss(self, input_ids: jax.Array, labels: jax.Array,
              ignore_index: int = -100) -> jax.Array:
-        logits = self(input_ids)
-        return parallel_cross_entropy_mean(logits, labels, ignore_index=ignore_index)
+        cfg = self.config
+        x = self._hidden(input_ids)
+        b, s = labels.shape
+        chunk = cfg.loss_chunk_size or 4096
+        if s <= chunk:
+            return parallel_cross_entropy_mean(self._head(x), labels,
+                                               ignore_index=ignore_index)
+        # chunked head+CE: per chunk, remat recomputes the head matmul and
+        # softmax internals in backward, so only the chunk's logits are ever
+        # live (unrolled python loop — chunk count is small and static;
+        # nn.remat is the lifted form flax requires for submodule calls
+        # under checkpoint). A non-dividing seq gets a final short chunk —
+        # falling back to the whole-seq path would re-create the very OOM
+        # this exists to remove.
+
+        def chunk_loss(mdl, xc, lc):
+            per_tok = parallel_cross_entropy(mdl._head(xc), lc,
+                                             ignore_index=ignore_index)
+            cnt = jnp.sum((lc != ignore_index).astype(jnp.float32))
+            return jnp.sum(per_tok), cnt
+
+        chunk_loss = nn.remat(chunk_loss,
+                              policy=jax.checkpoint_policies.nothing_saveable,
+                              prevent_cse=False)
+        total = jnp.zeros((), jnp.float32)
+        count = jnp.zeros((), jnp.float32)
+        for i in range(0, s, chunk):
+            sl, cn = chunk_loss(self, x[:, i:i + chunk], labels[:, i:i + chunk])
+            total, count = total + sl, count + cn
+        return total / jnp.maximum(count, 1.0)

@@ -146,3 +146,31 @@ def test_tp_flash_shard_map_path():
     with jax.set_mesh(st.mesh):
         out = jax.jit(model_flash.apply)(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-3, atol=2e-3)
+
+
+def test_chunked_loss_matches_plain_exactly():
+    """Long-seq CE chunking (head matmul + CE per sequence chunk under
+    remat) must match the whole-sequence loss in value AND grads — the 32k
+    memory lever cannot change numerics (scripts/validate_long_seq.py gate)."""
+    cfg_kw = {**TINY, "max_seq_len": 64, "remat_policy": None}
+    ids = _ids((2, 64), 7)
+    labels = np.array(_ids((2, 64), 8))
+    labels[:, :5] = -100
+    labels = jnp.asarray(labels)
+    m_plain = LlamaForCausalLM(LlamaConfig(**{**cfg_kw, "loss_chunk_size": 9999}))
+    m_chunk = LlamaForCausalLM(LlamaConfig(**{**cfg_kw, "loss_chunk_size": 16}))
+    from flax.core import meta
+
+    params = meta.unbox(m_plain.init(jax.random.PRNGKey(0), ids))
+
+    def loss(m, p):
+        return m.apply(p, ids, labels, method=LlamaForCausalLM.loss,
+                       ignore_index=-100)
+
+    np.testing.assert_allclose(float(loss(m_chunk, params)),
+                               float(loss(m_plain, params)), rtol=1e-6)
+    g1 = jax.grad(lambda p: loss(m_plain, p))(params)
+    g2 = jax.grad(lambda p: loss(m_chunk, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6), g1, g2)

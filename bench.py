@@ -156,7 +156,8 @@ def bench_inference_ttft(prompt_len=2048, depths=(2, 6), trials=7, decode_steps=
         from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
 
         assert prompt_len >= 128 and flash_supported(
-            prompt_len, lcfg.max_seq_len, *lcfg.blocks_for(prompt_len)
+            prompt_len, lcfg.max_seq_len,
+            *lcfg.blocks_for(prompt_len, lcfg.max_seq_len)
         ), "TTFT config must exercise the flash-prefill path, not dense fallback"
         ids = jnp.zeros((1, 8), jnp.int32)
         model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg), ids)

@@ -49,6 +49,9 @@ def build_config(args, seq: int) -> LlamaConfig:
 
 def main(argv=None) -> float:
     parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--shard_glob", type=str, default=None,
+                        help="token-shard files (data.TokenShardDataset); "
+                             "default: hermetic synthetic batches")
     args = parser.parse_args(argv)
     if args.tiny:
         from common import force_cpu_mesh
@@ -58,6 +61,15 @@ def main(argv=None) -> float:
     batch = args.batch_size or (4 if args.tiny else 8)
     seq = args.seq_len or (32 if args.tiny else 4096)
     steps = args.steps or (4 if args.tiny else 100)
+    if args.shard_glob:
+        import glob as _glob
+
+        from neuronx_distributed_tpu.data import TokenShardDataset
+
+        shard_paths = sorted(_glob.glob(args.shard_glob))
+        ds = TokenShardDataset(shard_paths, batch_size=batch,
+                               shuffle_seed=args.seed)
+        seq = ds.seq_len  # the shards define the sequence length
 
     lcfg = build_config(args, seq)
     nxd_config = neuronx_distributed_config(
@@ -67,7 +79,10 @@ def main(argv=None) -> float:
                           "max_grad_norm": 1.0},
         mixed_precision_config={"use_master_weights": True},
     )
-    batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
+    if args.shard_glob:
+        batches = iter(ds)
+    else:
+        batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
     sample = next(batches)
     model = initialize_parallel_model(
         nxd_config, lambda: LlamaForCausalLM(lcfg), sample["ids"]

@@ -1,0 +1,9 @@
+"""Input pipeline (reference: pre-tokenized HDF5 shard datasets read through
+native libhdf5 + worker-pool DataLoaders in the training examples; SURVEY
+§2.2 native-dependency surface). The TPU build's equivalent: a binary
+token-shard format with a native C++ mmap+prefetch reader."""
+
+from neuronx_distributed_tpu.data.loader import (  # noqa: F401
+    TokenShardDataset,
+    write_token_shard,
+)

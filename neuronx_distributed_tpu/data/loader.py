@@ -1,0 +1,185 @@
+"""Token-shard dataset: native mmap reader with background prefetch.
+
+Role-parity with the reference's input pipeline (pre-tokenized HDF5 shards
+read through libhdf5(C) + a worker-pool DataLoader,
+``examples/training/tp_dp_bert_large_hf_pretrain_hdf5.py`` ``pretraining_dataset``
+— SURVEY §2.2 lists the native dependency surface the TPU build must match):
+the hot loop must never wait on host IO. The reader is C++
+(``_native/tokenshard.cpp``: mmap'd shards, epoch shuffling, a prefetch
+thread and bounded queue), bound via ctypes — no pybind11 — and compiled on
+first use with g++ (cached beside the source). A pure-numpy fallback keeps
+environments without a toolchain working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = 0x4E58445348415244  # "NXDSHARD"
+_HEADER = np.dtype([("magic", "<u8"), ("seq_len", "<u8"), ("num_seqs", "<u8")])
+
+
+def write_token_shard(path: str, tokens: np.ndarray) -> None:
+    """Write a (num_seqs, seq_len) int32 token array as a shard file."""
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    if tokens.ndim != 2:
+        raise ValueError(f"tokens must be (num_seqs, seq_len), got {tokens.shape}")
+    header = np.zeros((), _HEADER)
+    header["magic"] = _MAGIC
+    header["seq_len"] = tokens.shape[1]
+    header["num_seqs"] = tokens.shape[0]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as fh:
+        fh.write(header.tobytes())
+        fh.write(tokens.tobytes())
+
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Compile (once, cached) and load the C++ reader; None if no toolchain."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    src_dir = os.path.join(os.path.dirname(__file__), "_native")
+    src = os.path.join(src_dir, "tokenshard.cpp")
+    so = os.path.join(src_dir, "libtokenshard.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 src, "-o", so],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        lib.tsr_open.restype = ctypes.c_void_p
+        lib.tsr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        lib.tsr_next.restype = ctypes.c_int
+        lib.tsr_next.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int32)]
+        lib.tsr_total_seqs.restype = ctypes.c_uint64
+        lib.tsr_total_seqs.argtypes = [ctypes.c_void_p]
+        lib.tsr_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+class TokenShardDataset:
+    """Iterate `{"ids", "labels"}` LM batches from token shards.
+
+    ``labels`` are next-token shifted; the final position's label is the
+    ignore index (the synthetic generators yield seq_len+1 tokens instead —
+    shards store exactly seq_len, matching on-disk corpora)."""
+
+    def __init__(self, paths: Sequence[str], batch_size: int,
+                 shuffle: bool = True, shuffle_seed: int = 0,
+                 ignore_index: int = -100, native: Optional[bool] = None):
+        self.paths = list(paths)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.shuffle_seed = shuffle_seed
+        self.ignore_index = ignore_index
+        if not self.paths:
+            raise ValueError("no shard paths")
+        with open(self.paths[0], "rb") as fh:
+            header = np.frombuffer(fh.read(_HEADER.itemsize), _HEADER)[0]
+        if header["magic"] != _MAGIC:
+            raise ValueError(f"{self.paths[0]}: not a token shard")
+        self.seq_len = int(header["seq_len"])
+        lib = _load_native() if native in (None, True) else None
+        if native is True and lib is None:
+            raise RuntimeError("native reader requested but g++ build failed")
+        self._lib = lib
+        self._handle = None
+
+    @property
+    def using_native(self) -> bool:
+        return self._lib is not None
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self._lib is not None:
+            yield from self._iter_native()
+        else:
+            yield from self._iter_python()
+
+    def _to_batch(self, ids: np.ndarray) -> Dict[str, np.ndarray]:
+        labels = np.full_like(ids, self.ignore_index)
+        labels[:, :-1] = ids[:, 1:]
+        return {"ids": ids, "labels": labels}
+
+    @property
+    def _native_seed(self) -> int:
+        # the C reader's 0 means "no shuffle"; +1 keeps user seed 0 shuffling
+        return (self.shuffle_seed + 1) if self.shuffle else 0
+
+    def _iter_native(self):
+        lib = self._lib
+        c_paths = (ctypes.c_char_p * len(self.paths))(
+            *[p.encode() for p in self.paths])
+        handle = lib.tsr_open(c_paths, len(self.paths), self.seq_len,
+                              self.batch_size, self._native_seed)
+        if not handle:
+            raise RuntimeError(f"tsr_open failed for {self.paths}")
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        try:
+            while True:
+                rc = lib.tsr_next(
+                    handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                if rc != 0:
+                    return
+                yield self._to_batch(out.copy())
+        finally:
+            lib.tsr_close(handle)
+
+    def _iter_python(self):
+        """Fallback: numpy memmap with the native reader's stream semantics —
+        per-ROW cursor that wraps+reshuffles at epoch boundaries, so a
+        non-dividing batch size carries its remainder into the next epoch's
+        first batch exactly like tokenshard.cpp's fill_batch (and
+        total < batch_size still yields batches). With ``shuffle=False``
+        the two backends are bit-identical; shuffled permutations differ
+        (std::mt19937_64 vs numpy RandomState) but cover the same epochs."""
+        maps: List[np.ndarray] = []
+        for p in self.paths:
+            header = np.fromfile(p, _HEADER, count=1)[0]
+            if header["magic"] != _MAGIC or int(header["seq_len"]) != self.seq_len:
+                raise ValueError(f"{p}: bad shard header")
+            maps.append(np.memmap(p, np.int32, "r", offset=_HEADER.itemsize,
+                                  shape=(int(header["num_seqs"]), self.seq_len)))
+        total = sum(m.shape[0] for m in maps)
+
+        def lookup(gi: int) -> np.ndarray:
+            for m in maps:
+                if gi < m.shape[0]:
+                    return m[gi]
+                gi -= m.shape[0]
+            raise IndexError(gi)
+
+        def make_order(epoch: int) -> np.ndarray:
+            if not self.shuffle:
+                return np.arange(total)
+            return np.random.RandomState(
+                self.shuffle_seed + epoch).permutation(total)
+
+        epoch, cursor = 0, 0
+        order = make_order(epoch)
+        while True:
+            ids = np.empty((self.batch_size, self.seq_len), np.int32)
+            for row in range(self.batch_size):
+                if cursor >= total:
+                    cursor, epoch = 0, epoch + 1
+                    order = make_order(epoch)
+                ids[row] = lookup(int(order[cursor]))
+                cursor += 1
+            yield self._to_batch(ids)

@@ -9,11 +9,21 @@ compiled executable IS the loaded SPMD program (PJRT owns multi-chip
 execution), the router is a shape lookup, and flattener/packer are jax
 pytree flatten/unflatten. Buffer donation (``donate_argnums``) replaces the
 metaneff input/output aliasing table for KV-cache state.
+
+Artifact packaging (reference ``parallel_model_save``/``load``,
+trace/trace.py:366-415, and ModelBuilder's TorchScript bundle): ``save``
+serializes every traced (key, bucket) program as StableHLO via
+``jax.export`` plus a routing manifest — a server process loads and serves
+them WITHOUT the model's Python code (the NEFF-archive role). Weight
+sharding to per-rank safetensors (reference ``shard_weights``,
+model_builder.py:315-331) lives in :func:`shard_weights_to_safetensors`.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -42,10 +52,11 @@ def pad_to(x: jax.Array, shape: Sequence[int]) -> jax.Array:
 
 @dataclasses.dataclass
 class _Entry:
-    fn: Callable
+    fn: Optional[Callable]
     example_args: Tuple
     donate_argnums: Tuple[int, ...]
     compiled: Optional[Any] = None
+    jitted: Optional[Any] = None
 
 
 class NxDModel:
@@ -121,6 +132,127 @@ class ModelBuilder:
     def trace(self) -> NxDModel:
         for key, entries in self._entries.items():
             for e in entries:
-                jitted = jax.jit(e.fn, donate_argnums=e.donate_argnums)
-                e.compiled = jitted.lower(*e.example_args).compile()
+                e.jitted = jax.jit(e.fn, donate_argnums=e.donate_argnums)
+                e.compiled = e.jitted.lower(*e.example_args).compile()
         return NxDModel(self._entries)
+
+
+# --- artifact save/load ----------------------------------------------------
+
+def save_model(model: NxDModel, path: str) -> None:
+    """Serialize every (key, bucket) program as StableHLO + a routing
+    manifest (reference parallel_model_save, trace.py:366). The saved bundle
+    is self-contained: loading needs no model code."""
+    from jax import export as jexport
+
+    os.makedirs(path, exist_ok=True)
+    manifest: Dict[str, List[dict]] = {}
+    for key, entries in model._entries.items():
+        manifest[key] = []
+        for i, e in enumerate(entries):
+            if e.jitted is None:
+                raise ValueError("save_model needs a traced model (ModelBuilder.trace)")
+            exp = jexport.export(e.jitted)(*e.example_args)
+            fname = f"{key}_{i}.stablehlo"
+            with open(os.path.join(path, fname), "wb") as fh:
+                fh.write(exp.serialize())
+            manifest[key].append(
+                {"file": fname, "donate_argnums": list(e.donate_argnums)}
+            )
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh)
+
+
+def load_model(path: str) -> NxDModel:
+    """Deserialize a saved bundle (reference parallel_model_load,
+    trace.py:391): programs compile for the local devices at first call;
+    bucket shapes for routing come from the exported input avals."""
+    from jax import export as jexport
+
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    entries: Dict[str, List[_Entry]] = {}
+    for key, items in manifest.items():
+        entries[key] = []
+        for item in items:
+            with open(os.path.join(path, item["file"]), "rb") as fh:
+                exp = jexport.deserialize(fh.read())
+            example = tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in exp.in_avals
+            )
+            entries[key].append(_Entry(
+                fn=None, example_args=example,
+                donate_argnums=tuple(item["donate_argnums"]),
+                compiled=exp.call,
+            ))
+    return NxDModel(entries)
+
+
+# --- weight sharding to safetensors ----------------------------------------
+
+def shard_weights_to_safetensors(params: PyTree, specs: PyTree, mesh,
+                                 out_dir: str, axis: str = "tp") -> None:
+    """Write one safetensors file per ``axis`` rank holding that rank's
+    weight shards (reference ``ModelBuilder.shard_weights``,
+    model_builder.py:315-331 — per-rank safetensors the native runtime
+    loads). A ``shard_meta.json`` records each tensor's sharded dim so
+    :func:`load_sharded_safetensors` can reassemble."""
+    from safetensors.numpy import save_file
+
+    size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    flat_p = {jax.tree_util.keystr(k): np.asarray(v)
+              for k, v in jax.tree_util.tree_leaves_with_path(params)}
+    from jax.sharding import PartitionSpec as P
+
+    flat_s = {
+        jax.tree_util.keystr(k): v
+        for k, v in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P) or x is None)[0]
+    }
+
+    def sharded_dim(spec) -> int:
+        if not isinstance(spec, P):
+            return -1
+        for d, entry in enumerate(spec):
+            axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            if axis in axes:
+                return d
+        return -1
+
+    meta = {name: sharded_dim(flat_s.get(name)) for name in flat_p}
+    for name, d in meta.items():
+        if d >= 0 and flat_p[name].shape[d] % size != 0:
+            raise ValueError(
+                f"{name}: dim {d} ({flat_p[name].shape[d]}) not divisible by "
+                f"{axis} size {size} — silent truncation refused"
+            )
+    os.makedirs(out_dir, exist_ok=True)
+    for r in range(size):
+        shard = {}
+        for name, arr in flat_p.items():
+            d = meta[name]
+            if d < 0:
+                shard[name] = arr  # replicated: every rank carries a copy
+            else:
+                n = arr.shape[d] // size
+                shard[name] = np.take(arr, range(r * n, (r + 1) * n), axis=d)
+        save_file(shard, os.path.join(out_dir, f"weights_rank_{r}.safetensors"))
+    with open(os.path.join(out_dir, "shard_meta.json"), "w") as fh:
+        json.dump({"axis": axis, "size": size, "dims": meta}, fh)
+
+
+def load_sharded_safetensors(out_dir: str) -> Dict[str, np.ndarray]:
+    """Reassemble the full (unsharded) flat weight dict from per-rank files."""
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(out_dir, "shard_meta.json")) as fh:
+        meta = json.load(fh)
+    shards = [load_file(os.path.join(out_dir, f"weights_rank_{r}.safetensors"))
+              for r in range(meta["size"])]
+    out = {}
+    for name, d in meta["dims"].items():
+        if d < 0:
+            out[name] = shards[0][name]
+        else:
+            out[name] = np.concatenate([s[name] for s in shards], axis=d)
+    return out

@@ -82,3 +82,20 @@ def test_mixtral_moe_tiny():
 
     loss = mixtral_moe.main(["--tiny", "--steps", "2", "--log_every", "0"])
     assert np.isfinite(loss)
+
+
+def test_llama_zero1_with_token_shards(tmp_path):
+    """The TP+ZeRO1 example trains from real token shards through the native
+    reader (--shard_glob path)."""
+    from neuronx_distributed_tpu.data import write_token_shard
+
+    rs = np.random.RandomState(0)
+    write_token_shard(str(tmp_path / "s0.bin"),
+                      rs.randint(0, 511, (32, 32)).astype(np.int32))
+    import llama2_tp_zero1
+
+    loss = llama2_tp_zero1.main([
+        "--tiny", "--steps", "2", "--log_every", "0",
+        "--shard_glob", str(tmp_path / "*.bin"),
+    ])
+    assert np.isfinite(loss)

@@ -117,8 +117,11 @@ bool map_shard(const char* path, uint64_t expect_seq_len, Shard* out) {
     return false;
   }
   uint64_t num_seqs = hdr[2];
-  if (static_cast<uint64_t>(st.st_size) <
-      24 + num_seqs * expect_seq_len * sizeof(int32_t)) {
+  // divide, don't multiply: `num_seqs * seq_len * 4` overflows uint64 for a
+  // corrupt header and would bypass the size check into OOB reads
+  uint64_t payload = static_cast<uint64_t>(st.st_size) - 24;
+  if (expect_seq_len == 0 ||
+      num_seqs > payload / (expect_seq_len * sizeof(int32_t))) {
     munmap(m, st.st_size);
     return false;
   }
@@ -150,7 +153,11 @@ void* tsr_open(const char** paths, int n_paths, uint64_t seq_len,
     r->total_seqs += s.num_seqs;
     r->shards.push_back(s);
   }
-  if (r->total_seqs == 0) { delete r; return nullptr; }
+  if (r->total_seqs == 0) {
+    for (Shard& sh : r->shards) munmap(sh.map, sh.map_len);
+    delete r;
+    return nullptr;
+  }
   r->reshuffle();
   r->worker = std::thread([r] { r->run(); });
   return r;

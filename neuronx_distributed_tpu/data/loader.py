@@ -54,11 +54,16 @@ def _load_native() -> Optional[ctypes.CDLL]:
     try:
         if (not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)):
+            # build to a process-unique temp then atomically rename: two
+            # processes racing the first build must never dlopen a
+            # partially-written .so
+            tmp = f"{so}.{os.getpid()}.tmp"
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 src, "-o", so],
+                 src, "-o", tmp],
                 check=True, capture_output=True,
             )
+            os.replace(tmp, so)
         lib = ctypes.CDLL(so)
         lib.tsr_open.restype = ctypes.c_void_p
         lib.tsr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,

@@ -42,6 +42,21 @@ def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def _merge_cache_slots(old: PyTree, new: PyTree, sel: jax.Array,
+                       new_len: jax.Array) -> PyTree:
+    """Per-slot cache merge: selected batch rows take the freshly prefilled
+    state (KV rows + their true prompt lengths), unselected rows keep their
+    in-flight state. Cache leaves are layer-stacked with batch at axis 1."""
+
+    def merge(path, o, n):
+        if jax.tree_util.keystr(path).endswith("['cache_index']"):
+            return jnp.where(sel[None, :], new_len[None, :].astype(o.dtype), o)
+        shape = (1, -1) + (1,) * (o.ndim - 2)
+        return jnp.where(sel.reshape(shape), n, o)
+
+    return jax.tree_util.tree_map_with_path(merge, old, new)
+
+
 def infer_prompt_lengths(prompt_ids: np.ndarray, pad_token_id: int = 0) -> np.ndarray:
     """Length of each right-padded prompt = 1 + rightmost non-pad position.
     Robust to ``pad_token_id`` occurring INSIDE a prompt (only the trailing
@@ -117,6 +132,90 @@ class CausalLM:
             if s <= b:
                 return b
         raise ValueError(f"prompt length {s} exceeds largest bucket {self.buckets[-1]}")
+
+    # --- continuous batching (slot-level session API) --------------------
+    # The reference reorders sequences into KV-cache slots via its seq_ids
+    # machinery (model_wrapper.py:207); here the cache is explicit state and
+    # slots are batch rows: `insert` prefills CHOSEN rows while the other
+    # rows' cache entries are untouched mid-generation.
+
+    def start_session(self) -> PyTree:
+        """Empty KV cache for a decode session (all slots free). The session
+        tracks per-slot lengths HOST-side so insert/step can refuse writes
+        past ``max_seq_len`` (the in-model scatter would silently drop them
+        — same guard generate() applies)."""
+        if self._decode is None:
+            self.compile()
+        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+
+        def prefill_shape(params, ids):
+            _, mut = self.model.apply({"params": params}, ids, mutable=["cache"])
+            return mut["cache"]
+
+        cache = jax.eval_shape(prefill_shape, self.params, ids0)
+        self._session_len = np.zeros((self.max_batch,), np.int64)
+        self._session_active = np.zeros((self.max_batch,), bool)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+
+    def insert(self, cache: PyTree, slot_ids: np.ndarray, prompt_ids: np.ndarray,
+               lengths: Optional[np.ndarray] = None, pad_token_id: int = 0
+               ) -> Tuple[PyTree, jax.Array]:
+        """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
+        rows and lengths are preserved (they may be mid-generation).
+        Returns ``(cache, next_token_logits (len(slot_ids), vocab))``."""
+        if self._decode is None:
+            self.compile()
+        slot_ids = np.asarray(slot_ids, np.int32)
+        b, s = prompt_ids.shape
+        if b != len(slot_ids):
+            raise ValueError(f"{b} prompts for {len(slot_ids)} slots")
+        if lengths is None:
+            lengths = infer_prompt_lengths(prompt_ids, pad_token_id)
+        lengths = np.maximum(np.asarray(lengths, np.int32), 1)
+        if int(lengths.max()) >= self.config.max_seq_len:
+            raise ValueError(
+                f"prompt length {int(lengths.max())} leaves no decode room in "
+                f"max_seq_len {self.config.max_seq_len}"
+            )
+        bucket = self._bucket_for(s)
+        ids = np.zeros((self.max_batch, bucket), np.int32)
+        ids[slot_ids, :s] = prompt_ids
+        logits, fresh = self._prefill[bucket](self.params, jnp.asarray(ids))
+        sel = np.zeros((self.max_batch,), bool)
+        sel[slot_ids] = True
+        new_len = np.zeros((self.max_batch,), np.int32)
+        new_len[slot_ids] = lengths
+        cache = _merge_cache_slots(cache, fresh, jnp.asarray(sel),
+                                   jnp.asarray(new_len))
+        if hasattr(self, "_session_len"):
+            self._session_len[slot_ids] = lengths
+            self._session_active[slot_ids] = True
+        last = jnp.asarray(np.maximum(lengths - 1, 0))
+        return cache, logits[jnp.asarray(slot_ids), last]
+
+    def step(self, cache: PyTree, tokens: np.ndarray) -> Tuple[jax.Array, PyTree]:
+        """One decode step for ALL slots (inactive slots advance harmlessly —
+        mask their outputs caller-side). ``tokens``: (max_batch,). Raises
+        when an ACTIVE slot would write past ``max_seq_len`` (re-insert or
+        retire it first; the scatter would otherwise drop silently)."""
+        if hasattr(self, "_session_len"):
+            self._session_len += 1
+            over = self._session_active & (self._session_len >= self.config.max_seq_len)
+            if over.any():
+                raise ValueError(
+                    f"slots {np.nonzero(over)[0].tolist()} exhausted max_seq_len "
+                    f"{self.config.max_seq_len}: re-insert or retire them"
+                )
+        logits, cache = self._decode(
+            self.params, cache, jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
+        )
+        return logits[:, 0], cache
+
+    def retire(self, slot_ids) -> None:
+        """Mark slots idle (stops their overflow accounting; their cache rows
+        are reused by the next insert)."""
+        if hasattr(self, "_session_len"):
+            self._session_active[np.asarray(slot_ids, np.int32)] = False
 
     # --- generation ------------------------------------------------------
 

@@ -58,6 +58,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16        # compute dtype (mixed_precision_config.compute_dtype)
     param_dtype: Any = jnp.float32   # storage dtype (master weights live in optimizer)
     sequence_parallel: bool = False
+    # ring-attention context parallelism over the "cp" mesh axis: the
+    # sequence stays sharded THROUGH attention (ops/ring_attention.py) — a
+    # TPU-native extension beyond the reference (SURVEY §2.3: no CP there)
+    context_parallel: bool = False
     use_flash_attention: bool = True
     # None = sequence-adaptive choice (kernels.flash_attn.default_attention_blocks)
     attention_block_q: Optional[int] = None
@@ -204,18 +208,27 @@ class LlamaAttention(nn.Module):
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
-
         s = x.shape[1]
-        blk_q, blk_k = cfg.blocks_for(s)
-        # BSND -> BHSD for the kernel
-        o = attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-            causal=True,
-            use_flash=cfg.use_flash_attention and flash_supported(s, s, blk_q, blk_k),
-            block_q=blk_q,
-            block_k=blk_k,
-        )
+        if cfg.context_parallel:
+            from neuronx_distributed_tpu.ops.ring_attention import ring_attention
+
+            o = ring_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True,
+            )
+        else:
+            from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
+
+            blk_q, blk_k = cfg.blocks_for(s)
+            # BSND -> BHSD for the kernel
+            o = attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                causal=True,
+                use_flash=cfg.use_flash_attention and flash_supported(s, s, blk_q, blk_k),
+                block_q=blk_q,
+                block_k=blk_k,
+            )
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
         return self._o_proj(o)
 
@@ -421,7 +434,14 @@ class LlamaModel(nn.Module):
         positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
         # cos/sin computed ONCE here (not per scanned layer) and broadcast
         rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
-        x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
+        if cfg.context_parallel:
+            if cfg.sequence_parallel:
+                raise ValueError("sequence_parallel and context_parallel are exclusive")
+            from neuronx_distributed_tpu.parallel.partitioning import ACT_CP
+
+            x = constrain(x, ACT_CP)  # seq stays cp-sharded through the stack
+        else:
+            x = constrain(x, ACT_SP if cfg.sequence_parallel else ACT_FULL)
         if chunk_ctx is None:
             x, _ = self.layers(x, rope)
         else:
@@ -480,7 +500,9 @@ class LlamaForCausalLM(nn.Module):
         x = self._hidden(input_ids)
         b, s = labels.shape
         chunk = cfg.loss_chunk_size or 4096
-        if s <= chunk:
+        if s <= chunk or cfg.context_parallel:
+            # under CP the tokens are already cp-sharded — per-chip logits are
+            # S/cp-sized and slicing the sharded dim would force resharding
             return parallel_cross_entropy_mean(self._head(x), labels,
                                                ignore_index=ignore_index)
         # chunked head+CE: per chunk, remat recomputes the head matmul and

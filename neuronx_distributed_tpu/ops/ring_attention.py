@@ -1,0 +1,155 @@
+"""Ring attention: context-parallel attention over the ``cp`` mesh axis.
+
+A TPU-native EXTENSION beyond the reference's capability surface (SURVEY
+§2.3: the reference has NO context parallelism — its long-context story is
+Megatron-SP + flash attention, validated to 32k). Ring attention removes the
+per-chip sequence ceiling: the sequence stays sharded through attention
+itself, and K/V shards rotate around the ``cp`` ring (``lax.ppermute`` over
+ICI) while each rank folds one block per step into a numerically-stable
+streaming softmax (max/sum-corrected accumulation — the flash-attention
+recurrence across ranks instead of across tiles).
+
+Design notes:
+* ``shard_map`` is partial-manual over ``{cp}`` only; batch/head shardings
+  (dp, tp) stay GSPMD-auto INSIDE the region — block math is plain jnp, so
+  the partitioner handles them (a Pallas call would need full-manual specs;
+  fusing the per-block compute into a kernel is the optimization path, the
+  collective dataflow here is already the ring).
+* Causal masking is position-based: rank ``r``'s queries sit at global
+  positions ``r*s_loc + i``; a rotating block carries its source rank's key
+  positions. Fully-future blocks compute and mask to zero — a zigzag
+  schedule that skips them is a further optimization, not a correctness
+  need.
+* Queries process their block in ``q_chunk`` slices so the (s_loc, s_loc)
+  score matrix never fully materializes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.mesh import CP_AXIS, DP_AXES, TP_AXIS
+
+_NEG = -1e30
+
+
+def _block_update(q, kb, vb, q_pos, k_pos, num, den, mx, sm_scale, causal):
+    """Fold one K/V block into the streaming-softmax state.
+    q (b,h,s,d); kb/vb (b,h,sk,d); num (b,h,s,d) f32; den/mx (b,h,s) f32."""
+    scores = jnp.einsum("bhsd,bhkd->bhsk", q.astype(jnp.float32),
+                        kb.astype(jnp.float32)) * sm_scale
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]              # (s, sk)
+        scores = jnp.where(mask[None, None], scores, _NEG)
+        maskf = mask[None, None].astype(jnp.float32)
+    else:
+        maskf = jnp.ones((), jnp.float32)
+    blk_mx = jnp.max(scores, axis=-1)
+    new_mx = jnp.maximum(mx, blk_mx)
+    # exp(scores - new_mx) <= 1 always (new_mx >= scores); masked entries are
+    # zeroed by the multiply, so the -1e30 sentinel never pollutes the sums
+    p = jnp.exp(scores - new_mx[..., None]) * maskf
+    corr = jnp.exp(mx - new_mx)
+    num = num * corr[..., None] + jnp.einsum("bhsk,bhkd->bhsd", p,
+                                             vb.astype(jnp.float32))
+    den = den * corr + jnp.sum(p, axis=-1)
+    return num, den, new_mx
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_chunk: int = 512,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> jax.Array:
+    """Context-parallel multi-head attention over BHSD tensors whose S dim is
+    sharded over the ``cp`` mesh axis. K/V may carry fewer (GQA) heads —
+    repeated locally. Returns the same layout as ``q``."""
+    mesh = mesh or ps.get_mesh()
+    cp = mesh.shape[CP_AXIS]
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    # GQA: the ring rotates COMPACT (n_kv) heads — expanding before the ring
+    # would multiply every ppermute's ICI bytes by the group factor; heads
+    # expand locally right before each block's compute
+    rep = q.shape[1] // k.shape[1]
+
+    def local_fn(q, k, v):
+        rank = lax.axis_index(CP_AXIS)
+        b, h, s_loc, d = q.shape
+        q_pos = rank * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+        num0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+        den0 = jnp.zeros((b, h, s_loc), jnp.float32)
+        mx0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def fold_block(i, kb, vb, num, den, mx):
+            """Fold the block currently held (home rank = rank - i)."""
+            src = jnp.mod(rank - i, cp)
+            k_pos = src * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+            kbf = jnp.repeat(kb, rep, axis=1) if rep > 1 else kb
+            vbf = jnp.repeat(vb, rep, axis=1) if rep > 1 else vb
+
+            def q_chunk_step(carry_q, j):
+                num, den, mx = carry_q
+                sl = lambda a: lax.dynamic_slice_in_dim(a, j * q_chunk, q_chunk, 2)  # noqa: E731
+                n_j, d_j, m_j = _block_update(
+                    sl(q), kbf, vbf,
+                    lax.dynamic_slice_in_dim(q_pos, j * q_chunk, q_chunk, 0),
+                    k_pos,
+                    sl(num), lax.dynamic_slice_in_dim(den, j * q_chunk, q_chunk, 2),
+                    lax.dynamic_slice_in_dim(mx, j * q_chunk, q_chunk, 2),
+                    sm_scale, causal,
+                )
+                num = lax.dynamic_update_slice_in_dim(num, n_j, j * q_chunk, 2)
+                den = lax.dynamic_update_slice_in_dim(den, d_j, j * q_chunk, 2)
+                mx = lax.dynamic_update_slice_in_dim(mx, m_j, j * q_chunk, 2)
+                return (num, den, mx), None
+
+            if s_loc > q_chunk and s_loc % q_chunk == 0:
+                (num, den, mx), _ = lax.scan(
+                    q_chunk_step, (num, den, mx),
+                    jnp.arange(s_loc // q_chunk),
+                )
+            else:
+                num, den, mx = _block_update(q, kbf, vbf, q_pos, k_pos,
+                                             num, den, mx, sm_scale, causal)
+            return num, den, mx
+
+        def ring_step(carry, i):
+            kb, vb, num, den, mx = carry
+            num, den, mx = fold_block(i, kb, vb, num, den, mx)
+            kb = lax.ppermute(kb, CP_AXIS, perm)
+            vb = lax.ppermute(vb, CP_AXIS, perm)
+            return (kb, vb, num, den, mx), None
+
+        if cp > 1:  # cp-1 rotate-and-fold steps...
+            (kb, vb, num, den, mx), _ = lax.scan(
+                jax.checkpoint(ring_step), (k, v, num0, den0, mx0),
+                jnp.arange(cp - 1),
+            )
+        else:
+            kb, vb, num, den, mx = k, v, num0, den0, mx0
+        # ...then fold the final block WITHOUT the (wasted) last rotation
+        num, den, mx = jax.checkpoint(
+            lambda kb, vb, num, den, mx: fold_block(cp - 1, kb, vb, num, den, mx)
+        )(kb, vb, num, den, mx)
+        # causal self-attention: the diagonal is always visible, den > 0
+        return (num / jnp.maximum(den, 1e-20)[..., None]).astype(q.dtype)
+
+    # partial-manual over {cp}: specs describe ONLY the manual axis — batch
+    # and head shardings (dp, tp) remain GSPMD-auto inside the region
+    spec = P(None, None, CP_AXIS, None)
+    return jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={CP_AXIS}, check_vma=False,
+    )(q, k, v)

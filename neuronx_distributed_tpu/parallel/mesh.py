@@ -56,8 +56,9 @@ logger = logging.getLogger("nxd")
 PP_AXIS = "pp"
 EDP_AXIS = "edp"  # expert-data-parallel: DP leftover after EP split
 EP_AXIS = "ep"
+CP_AXIS = "cp"  # context parallel: ring-attention sequence sharding
 TP_AXIS = "tp"
-MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, TP_AXIS)
+MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, CP_AXIS, TP_AXIS)
 # The reference's plain data-parallel group == (edp x ep) combined
 # (parallel_state.py:285-298: DP is the product of everything that is not
 # TP/PP; EP subdivides it in the expert view).
@@ -74,6 +75,7 @@ class ParallelState:
     expert_model_parallel_size: int
     data_parallel_size: int
     expert_data_parallel_size: int
+    context_parallel_size: int = 1
 
     @property
     def world_size(self) -> int:
@@ -87,13 +89,17 @@ def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     expert_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> ParallelState:
     """Build the global device mesh (reference ``initialize_model_parallel``,
     ``parallel_state.py:60``).
 
-    world = pp * dp * tp, with dp = edp * ep. Raises if the device count does
-    not factor (mirrors the reference's divisibility asserts).
+    world = pp * dp * cp * tp, with dp = edp * ep. Raises if the device
+    count does not factor (mirrors the reference's divisibility asserts).
+    The ``cp`` axis is a TPU-native EXTENSION: the reference has no context
+    parallelism (SURVEY §2.3 — its long-context answer is SP+flash); ring
+    attention over ``cp`` shards the sequence through attention itself.
     """
     global _STATE
     if _STATE is not None:
@@ -102,9 +108,11 @@ def initialize_model_parallel(
     devs = list(devices) if devices is not None else list(jax.devices())
     world = len(devs)
     tp, pp, ep = tensor_model_parallel_size, pipeline_model_parallel_size, expert_model_parallel_size
-    if world % (tp * pp) != 0:
-        raise ValueError(f"world size {world} is not divisible by tp({tp}) * pp({pp})")
-    dp = world // (tp * pp)
+    cp = context_parallel_size
+    if world % (tp * pp * cp) != 0:
+        raise ValueError(
+            f"world size {world} is not divisible by tp({tp}) * pp({pp}) * cp({cp})")
+    dp = world // (tp * pp * cp)
     if dp % ep != 0:
         raise ValueError(f"data parallel size {dp} is not divisible by ep({ep})")
     edp = dp // ep
@@ -114,7 +122,7 @@ def initialize_model_parallel(
     # On real TPU slices jax.devices() is ordered so that neighbors in the
     # flat list are ICI neighbors; keeping TP fastest-varying places each TP
     # group on adjacent chips.
-    mesh_devices = np.asarray(devs, dtype=object).reshape(pp, edp, ep, tp)
+    mesh_devices = np.asarray(devs, dtype=object).reshape(pp, edp, ep, cp, tp)
     mesh = Mesh(mesh_devices, MESH_AXES)
 
     _STATE = ParallelState(
@@ -124,10 +132,11 @@ def initialize_model_parallel(
         expert_model_parallel_size=ep,
         data_parallel_size=dp,
         expert_data_parallel_size=edp,
+        context_parallel_size=cp,
     )
     logger.info(
-        "initialized model parallel: world=%d tp=%d pp=%d dp=%d (ep=%d edp=%d)",
-        world, tp, pp, dp, ep, edp,
+        "initialized model parallel: world=%d tp=%d pp=%d dp=%d (ep=%d edp=%d) cp=%d",
+        world, tp, pp, dp, ep, edp, cp,
     )
     return _STATE
 
@@ -179,6 +188,10 @@ def get_expert_data_parallel_size() -> int:
     return _require_state().expert_data_parallel_size
 
 
+def get_context_parallel_size() -> int:
+    return _require_state().context_parallel_size
+
+
 def get_world_size() -> int:
     return _require_state().world_size
 
@@ -221,9 +234,10 @@ def local_mesh_coords() -> dict:
             first = idx
             break
     if first is None:  # process owns no mesh device (shouldn't happen)
-        first = (0, 0, 0, 0)
-    pp, edp, ep, tp = first
-    return {"pp": pp, "edp": edp, "ep": ep, "tp": tp, "dp": edp * st.expert_model_parallel_size + ep}
+        first = (0, 0, 0, 0, 0)
+    pp, edp, ep, cp, tp = first
+    return {"pp": pp, "edp": edp, "ep": ep, "cp": cp, "tp": tp,
+            "dp": edp * st.expert_model_parallel_size + ep}
 
 
 def rmsg(msg: str) -> str:

@@ -30,6 +30,7 @@ from neuronx_distributed_tpu.parallel.mesh import DP_AXES, TP_AXIS
 ACT_FULL = P(DP_AXES, None, None)      # batch over DP, rest replicated
 ACT_TP = P(DP_AXES, None, TP_AXIS)     # hidden sharded over TP (between column/row linear)
 ACT_SP = P(DP_AXES, TP_AXIS, None)     # sequence sharded over TP (Megatron SP regions)
+ACT_CP = P(DP_AXES, "cp", None)        # sequence sharded over CP (ring attention)
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:

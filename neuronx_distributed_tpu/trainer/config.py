@@ -48,6 +48,7 @@ def neuronx_distributed_config(
     tensor_parallel_size: int = 1,
     pipeline_parallel_size: int = 1,
     expert_parallel_size: int = 1,
+    context_parallel_size: int = 1,
     sequence_parallel: Optional[bool] = None,
     pipeline_config: Optional[Dict[str, Any]] = None,
     optimizer_config: Optional[Dict[str, Any]] = None,
@@ -73,6 +74,7 @@ def neuronx_distributed_config(
         "tensor_parallel_size": int(tensor_parallel_size),
         "pipeline_parallel_size": int(pipeline_parallel_size),
         "expert_parallel_size": int(expert_parallel_size),
+        "context_parallel_size": int(context_parallel_size),
         "sequence_parallel": bool(sequence_parallel),  # None (default) -> False
         "pipeline_config": merged(_PIPELINE_DEFAULTS, pipeline_config, "pipeline_config"),
         "optimizer_config": merged(_OPTIMIZER_DEFAULTS, optimizer_config, "optimizer_config"),

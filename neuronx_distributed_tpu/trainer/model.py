@@ -109,6 +109,10 @@ def _apply_config_overrides(module: nn.Module, nxd_config: Dict[str, Any]) -> nn
         over["remat_policy"] = ac
     if explicit.get("sequence_parallel") and hasattr(cfg, "sequence_parallel"):
         over["sequence_parallel"] = bool(nxd_config.get("sequence_parallel"))
+    if nxd_config.get("context_parallel_size", 1) > 1 and hasattr(cfg, "context_parallel"):
+        # a cp mesh axis without ring attention would silently replicate the
+        # whole forward across cp ranks — turn the model's CP path on
+        over["context_parallel"] = True
     if not over:
         return module
     return type(module)(dataclasses.replace(cfg, **over))
@@ -136,6 +140,7 @@ def initialize_parallel_model(
             tensor_model_parallel_size=nxd_config["tensor_parallel_size"],
             pipeline_model_parallel_size=nxd_config["pipeline_parallel_size"],
             expert_model_parallel_size=nxd_config["expert_parallel_size"],
+            context_parallel_size=nxd_config.get("context_parallel_size", 1),
         )
     mesh = ps.get_mesh()
     module = _apply_config_overrides(module_fn(), nxd_config)

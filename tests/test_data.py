@@ -87,3 +87,25 @@ def test_python_fallback_batch_larger_than_total(shards):
     b = next(it)["ids"]
     np.testing.assert_array_equal(b[:16], rows)
     np.testing.assert_array_equal(b[16:], rows[:4])
+
+
+@needs_gxx
+def test_corrupt_num_seqs_rejected(tmp_path):
+    """A header whose num_seqs would overflow the size math must be refused
+    by the native reader, not SIGSEGV (r2 review)."""
+    import ctypes
+
+    p = str(tmp_path / "evil.bin")
+    header = np.zeros(3, "<u8")
+    header[0] = 0x4E58445348415244
+    header[1] = 16
+    header[2] = 2**61  # overflow bait
+    with open(p, "wb") as fh:
+        fh.write(header.tobytes())
+        fh.write(np.zeros((2, 16), np.int32).tobytes())
+    from neuronx_distributed_tpu.data.loader import _load_native
+
+    lib = _load_native()
+    c_paths = (ctypes.c_char_p * 1)(p.encode())
+    handle = lib.tsr_open(c_paths, 1, 16, 2, 0)
+    assert not handle  # rejected cleanly

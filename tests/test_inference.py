@@ -392,3 +392,81 @@ def test_shard_weights_safetensors_roundtrip(tmp_path):
     full = load_sharded_safetensors(str(tmp_path / "w"))
     np.testing.assert_array_equal(full["['a']['kernel']"], params["a"]["kernel"])
     np.testing.assert_array_equal(full["['a']['bias']"], params["a"]["bias"])
+
+
+def test_continuous_batching_insert_preserves_inflight_slot():
+    """Slot 0 decodes a prompt; mid-generation, slot 1 is inserted with a
+    NEW prompt. Slot 0's continuation must be bit-identical to an
+    undisturbed run (the reference's seq_ids continuous-batching contract,
+    model_wrapper.py:207)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=64,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None)
+    rs = np.random.RandomState(0)
+    p0 = rs.randint(1, 127, (1, 8)).astype(np.int32)
+    p1 = rs.randint(1, 127, (1, 8)).astype(np.int32)
+    model = LlamaForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(p0)))["params"]
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,), max_batch=2)
+
+    # golden: slot-0 prompt decoded alone, greedy
+    golden = lm.generate(p0, max_new_tokens=8).tokens[0]
+
+    # session: insert slot 0, decode 3 steps, then insert slot 1 mid-stream,
+    # continue 5 more steps for slot 0 while slot 1 also decodes
+    cache = lm.start_session()
+    cache, logits0 = lm.insert(cache, [0], p0)
+    toks0 = [int(jnp.argmax(logits0[0]))]
+    cur = np.zeros((2,), np.int32)
+    cur[0] = toks0[-1]
+    for _ in range(3):
+        logits, cache = lm.step(cache, cur)
+        toks0.append(int(jnp.argmax(logits[0])))
+        cur[0] = toks0[-1]
+    cache, logits1 = lm.insert(cache, [1], p1)
+    cur[1] = int(jnp.argmax(logits1[0]))
+    toks1 = [int(cur[1])]
+    for _ in range(4):
+        logits, cache = lm.step(cache, cur)
+        toks0.append(int(jnp.argmax(logits[0])))
+        toks1.append(int(jnp.argmax(logits[1])))
+        cur = np.asarray([toks0[-1], toks1[-1]], np.int32)
+    assert toks0 == golden.tolist()
+    # slot 1's stream equals ITS undisturbed golden too
+    golden1 = lm.generate(p1, max_new_tokens=5).tokens[0]
+    assert toks1 == golden1.tolist()
+
+
+def test_session_overflow_guard():
+    """step() must refuse to push an active slot past max_seq_len (the cache
+    scatter would silently drop the writes; r2 review)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_layers=1, num_heads=4, num_kv_heads=4, max_seq_len=12,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None)
+    ids = np.full((1, 8), 3, np.int32)
+    model = LlamaForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,), max_batch=2)
+    cache = lm.start_session()
+    cache, _ = lm.insert(cache, [0], ids)
+    cur = np.zeros((2,), np.int32)
+    for _ in range(3):  # lengths 8 -> 11 ok
+        _, cache = lm.step(cache, cur)
+    with pytest.raises(ValueError, match="exhausted max_seq_len"):
+        lm.step(cache, cur)
+    lm.retire([0])
+    lm.step(cache, cur)  # idle slots no longer guard
+    # over-long prompt refused outright
+    with pytest.raises(ValueError, match="no decode room"):
+        lm.insert(cache, [1], np.full((1, 8), 3, np.int32),
+                  lengths=np.asarray([12]))

@@ -15,8 +15,8 @@ def test_initialize_sizes():
     assert ps.get_pipeline_model_parallel_size() == 2
     assert ps.get_data_parallel_size() == 2
     assert ps.get_expert_model_parallel_size() == 1
-    assert st.mesh.devices.shape == (2, 2, 1, 2)
-    assert st.mesh.axis_names == ("pp", "edp", "ep", "tp")
+    assert st.mesh.devices.shape == (2, 2, 1, 1, 2)  # (pp, edp, ep, cp, tp)
+    assert st.mesh.axis_names == ("pp", "edp", "ep", "cp", "tp")
 
 
 def test_tp_innermost_contiguous():
@@ -33,7 +33,7 @@ def test_expert_view():
     st = ps.initialize_model_parallel(tensor_model_parallel_size=2, expert_model_parallel_size=2)
     assert ps.get_data_parallel_size() == 4
     assert ps.get_expert_data_parallel_size() == 2
-    assert st.mesh.devices.shape == (1, 2, 2, 2)
+    assert st.mesh.devices.shape == (1, 2, 2, 1, 2)
 
 
 def test_divisibility_errors():

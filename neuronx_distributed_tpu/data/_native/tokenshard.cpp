@@ -120,7 +120,8 @@ bool map_shard(const char* path, uint64_t expect_seq_len, Shard* out) {
   // divide, don't multiply: `num_seqs * seq_len * 4` overflows uint64 for a
   // corrupt header and would bypass the size check into OOB reads
   uint64_t payload = static_cast<uint64_t>(st.st_size) - 24;
-  if (expect_seq_len == 0 ||
+  // cap seq_len so the divisor can neither overflow nor reach zero
+  if (expect_seq_len == 0 || expect_seq_len > (1ULL << 32) ||
       num_seqs > payload / (expect_seq_len * sizeof(int32_t))) {
     munmap(m, st.st_size);
     return false;

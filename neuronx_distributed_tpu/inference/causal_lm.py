@@ -73,6 +73,17 @@ class GenerationResult:
     lengths: np.ndarray         # (b,) generated lengths incl. eos
 
 
+@dataclasses.dataclass
+class DecodeSession:
+    """Continuous-batching session: the KV cache plus host-side per-slot
+    accounting (so the overflow guard travels with the session — multiple
+    sessions never share counters)."""
+
+    cache: PyTree
+    lengths: np.ndarray         # (max_batch,) tokens written per slot
+    active: np.ndarray          # (max_batch,) slot in use
+
+
 class CausalLM:
     """Bucketed, KV-cached, continuous-batching text generation over any
     flax CLM whose config supports ``decode=True`` (LlamaForCausalLM et al).
@@ -135,15 +146,15 @@ class CausalLM:
 
     # --- continuous batching (slot-level session API) --------------------
     # The reference reorders sequences into KV-cache slots via its seq_ids
-    # machinery (model_wrapper.py:207); here the cache is explicit state and
-    # slots are batch rows: `insert` prefills CHOSEN rows while the other
-    # rows' cache entries are untouched mid-generation.
+    # machinery (model_wrapper.py:207); here the session object carries the
+    # cache plus HOST-side per-slot length accounting, and slots are batch
+    # rows: `insert` prefills CHOSEN rows while the other rows' cache
+    # entries are untouched mid-generation.
 
-    def start_session(self) -> PyTree:
-        """Empty KV cache for a decode session (all slots free). The session
-        tracks per-slot lengths HOST-side so insert/step can refuse writes
-        past ``max_seq_len`` (the in-model scatter would silently drop them
-        — same guard generate() applies)."""
+    def start_session(self) -> "DecodeSession":
+        """Fresh decode session (all slots free). Sessions are independent —
+        accounting travels WITH the session, so multiple concurrent sessions
+        keep their own overflow guards."""
         if self._decode is None:
             self.compile()
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
@@ -153,19 +164,33 @@ class CausalLM:
             return mut["cache"]
 
         cache = jax.eval_shape(prefill_shape, self.params, ids0)
-        self._session_len = np.zeros((self.max_batch,), np.int64)
-        self._session_active = np.zeros((self.max_batch,), bool)
-        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+        return DecodeSession(
+            cache=jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache),
+            lengths=np.zeros((self.max_batch,), np.int64),
+            active=np.zeros((self.max_batch,), bool),
+        )
 
-    def insert(self, cache: PyTree, slot_ids: np.ndarray, prompt_ids: np.ndarray,
-               lengths: Optional[np.ndarray] = None, pad_token_id: int = 0
-               ) -> Tuple[PyTree, jax.Array]:
+    def _check_slots(self, slot_ids: np.ndarray) -> None:
+        if len(slot_ids) == 0:
+            raise ValueError("empty slot_ids")
+        if len(np.unique(slot_ids)) != len(slot_ids):
+            raise ValueError(f"duplicate slot ids {slot_ids.tolist()}")
+        if (slot_ids < 0).any() or (slot_ids >= self.max_batch).any():
+            # negative ids would wrap via numpy indexing and clobber a live slot
+            raise ValueError(
+                f"slot ids {slot_ids.tolist()} out of range [0, {self.max_batch})"
+            )
+
+    def insert(self, session: "DecodeSession", slot_ids: np.ndarray,
+               prompt_ids: np.ndarray, lengths: Optional[np.ndarray] = None,
+               pad_token_id: int = 0) -> jax.Array:
         """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
         rows and lengths are preserved (they may be mid-generation).
-        Returns ``(cache, next_token_logits (len(slot_ids), vocab))``."""
+        Returns ``next_token_logits (len(slot_ids), vocab)``."""
         if self._decode is None:
             self.compile()
         slot_ids = np.asarray(slot_ids, np.int32)
+        self._check_slots(slot_ids)
         b, s = prompt_ids.shape
         if b != len(slot_ids):
             raise ValueError(f"{b} prompts for {len(slot_ids)} slots")
@@ -185,37 +210,39 @@ class CausalLM:
         sel[slot_ids] = True
         new_len = np.zeros((self.max_batch,), np.int32)
         new_len[slot_ids] = lengths
-        cache = _merge_cache_slots(cache, fresh, jnp.asarray(sel),
-                                   jnp.asarray(new_len))
-        if hasattr(self, "_session_len"):
-            self._session_len[slot_ids] = lengths
-            self._session_active[slot_ids] = True
+        session.cache = _merge_cache_slots(session.cache, fresh, jnp.asarray(sel),
+                                           jnp.asarray(new_len))
+        session.lengths[slot_ids] = lengths
+        session.active[slot_ids] = True
         last = jnp.asarray(np.maximum(lengths - 1, 0))
-        return cache, logits[jnp.asarray(slot_ids), last]
+        return logits[jnp.asarray(slot_ids), last]
 
-    def step(self, cache: PyTree, tokens: np.ndarray) -> Tuple[jax.Array, PyTree]:
+    def step(self, session: "DecodeSession", tokens: np.ndarray) -> jax.Array:
         """One decode step for ALL slots (inactive slots advance harmlessly —
         mask their outputs caller-side). ``tokens``: (max_batch,). Raises
-        when an ACTIVE slot would write past ``max_seq_len`` (re-insert or
-        retire it first; the scatter would otherwise drop silently)."""
-        if hasattr(self, "_session_len"):
-            self._session_len += 1
-            over = self._session_active & (self._session_len >= self.config.max_seq_len)
-            if over.any():
-                raise ValueError(
-                    f"slots {np.nonzero(over)[0].tolist()} exhausted max_seq_len "
-                    f"{self.config.max_seq_len}: re-insert or retire them"
-                )
+        — WITHOUT mutating any accounting — when an ACTIVE slot would write
+        past ``max_seq_len`` (re-insert or retire it first; the scatter would
+        otherwise drop silently)."""
+        over = session.active & (session.lengths + 1 >= self.config.max_seq_len)
+        if over.any():
+            raise ValueError(
+                f"slots {np.nonzero(over)[0].tolist()} exhausted max_seq_len "
+                f"{self.config.max_seq_len}: re-insert or retire them"
+            )
         logits, cache = self._decode(
-            self.params, cache, jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
+            self.params, session.cache, jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
         )
-        return logits[:, 0], cache
+        # account only after the decode actually executed
+        session.cache = cache
+        session.lengths += 1
+        return logits[:, 0]
 
-    def retire(self, slot_ids) -> None:
+    def retire(self, session: "DecodeSession", slot_ids) -> None:
         """Mark slots idle (stops their overflow accounting; their cache rows
         are reused by the next insert)."""
-        if hasattr(self, "_session_len"):
-            self._session_active[np.asarray(slot_ids, np.int32)] = False
+        slot_ids = np.asarray(slot_ids, np.int32)
+        self._check_slots(slot_ids)
+        session.active[slot_ids] = False
 
     # --- generation ------------------------------------------------------
 

@@ -109,9 +109,12 @@ def _apply_config_overrides(module: nn.Module, nxd_config: Dict[str, Any]) -> nn
         over["remat_policy"] = ac
     if explicit.get("sequence_parallel") and hasattr(cfg, "sequence_parallel"):
         over["sequence_parallel"] = bool(nxd_config.get("sequence_parallel"))
-    if nxd_config.get("context_parallel_size", 1) > 1 and hasattr(cfg, "context_parallel"):
-        # a cp mesh axis without ring attention would silently replicate the
-        # whole forward across cp ranks — turn the model's CP path on
+    # key on the MESH's cp size, not the config's: a user who initialized the
+    # mesh directly (cp>1) with a default config must still get the CP path —
+    # a cp axis without ring attention silently replicates the whole forward
+    cp = ps.get_context_parallel_size() if ps.model_parallel_is_initialized() else (
+        nxd_config.get("context_parallel_size", 1))
+    if cp > 1 and hasattr(cfg, "context_parallel"):
         over["context_parallel"] = True
     if not over:
         return module

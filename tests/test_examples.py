@@ -99,3 +99,10 @@ def test_llama_zero1_with_token_shards(tmp_path):
         "--shard_glob", str(tmp_path / "*.bin"),
     ])
     assert np.isfinite(loss)
+
+
+def test_gpt_neox_pretrain_tiny():
+    import gpt_neox_pretrain
+
+    loss = gpt_neox_pretrain.main(["--tiny", "--steps", "2", "--log_every", "0"])
+    assert np.isfinite(loss)

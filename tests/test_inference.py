@@ -419,20 +419,20 @@ def test_continuous_batching_insert_preserves_inflight_slot():
 
     # session: insert slot 0, decode 3 steps, then insert slot 1 mid-stream,
     # continue 5 more steps for slot 0 while slot 1 also decodes
-    cache = lm.start_session()
-    cache, logits0 = lm.insert(cache, [0], p0)
+    session = lm.start_session()
+    logits0 = lm.insert(session, [0], p0)
     toks0 = [int(jnp.argmax(logits0[0]))]
     cur = np.zeros((2,), np.int32)
     cur[0] = toks0[-1]
     for _ in range(3):
-        logits, cache = lm.step(cache, cur)
+        logits = lm.step(session, cur)
         toks0.append(int(jnp.argmax(logits[0])))
         cur[0] = toks0[-1]
-    cache, logits1 = lm.insert(cache, [1], p1)
+    logits1 = lm.insert(session, [1], p1)
     cur[1] = int(jnp.argmax(logits1[0]))
     toks1 = [int(cur[1])]
     for _ in range(4):
-        logits, cache = lm.step(cache, cur)
+        logits = lm.step(session, cur)
         toks0.append(int(jnp.argmax(logits[0])))
         toks1.append(int(jnp.argmax(logits[1])))
         cur = np.asarray([toks0[-1], toks1[-1]], np.int32)
@@ -457,16 +457,28 @@ def test_session_overflow_guard():
     model = LlamaForCausalLM(cfg)
     params = meta.unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
     lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,), max_batch=2)
-    cache = lm.start_session()
-    cache, _ = lm.insert(cache, [0], ids)
+    session = lm.start_session()
+    lm.insert(session, [0], ids)
     cur = np.zeros((2,), np.int32)
     for _ in range(3):  # lengths 8 -> 11 ok
-        _, cache = lm.step(cache, cur)
+        lm.step(session, cur)
+    before = session.lengths.copy()
     with pytest.raises(ValueError, match="exhausted max_seq_len"):
-        lm.step(cache, cur)
-    lm.retire([0])
-    lm.step(cache, cur)  # idle slots no longer guard
+        lm.step(session, cur)
+    # failed step must not mutate accounting (r2 review: desync)
+    np.testing.assert_array_equal(session.lengths, before)
+    lm.retire(session, [0])
+    lm.step(session, cur)  # idle slots no longer guard
     # over-long prompt refused outright
     with pytest.raises(ValueError, match="no decode room"):
-        lm.insert(cache, [1], np.full((1, 8), 3, np.int32),
+        lm.insert(session, [1], np.full((1, 8), 3, np.int32),
                   lengths=np.asarray([12]))
+    # slot-id validation: negative ids would wrap onto a live slot
+    with pytest.raises(ValueError, match="out of range"):
+        lm.insert(session, [-1], np.full((1, 8), 3, np.int32))
+    with pytest.raises(ValueError, match="duplicate"):
+        lm.insert(session, [1, 1], np.full((2, 8), 3, np.int32))
+    # independent sessions keep independent accounting
+    s2 = lm.start_session()
+    assert s2.lengths is not session.lengths
+    lm.step(s2, cur)  # fresh session: no overflow

@@ -239,9 +239,15 @@ class CausalLM:
 
     def retire(self, session: "DecodeSession", slot_ids) -> None:
         """Mark slots idle (stops their overflow accounting; their cache rows
-        are reused by the next insert)."""
-        slot_ids = np.asarray(slot_ids, np.int32)
-        self._check_slots(slot_ids)
+        are reused by the next insert). Idempotent and empty-safe — serving
+        loops call this with 'whatever finished this iteration'."""
+        slot_ids = np.asarray(slot_ids, np.int32).reshape(-1)
+        if len(slot_ids) == 0:
+            return
+        if (slot_ids < 0).any() or (slot_ids >= self.max_batch).any():
+            raise ValueError(
+                f"slot ids {slot_ids.tolist()} out of range [0, {self.max_batch})"
+            )
         session.active[slot_ids] = False
 
     # --- generation ------------------------------------------------------

@@ -36,6 +36,13 @@ class GPTNeoXConfig(LlamaConfig):
     rotary_pct: float = 0.25
     use_parallel_residual: bool = True
     layer_norm_eps: float = 1e-5
+    norm_type: str = "layernorm"  # NeoX's final norm is biased LayerNorm
+
+    @property
+    def rope_dims(self) -> int:
+        # tables built ONCE at the model level for the partial-rotary dims
+        # (NeoX frequencies use rotary_dims as the denominator base)
+        return int(self.head_dim_ * self.rotary_pct)
 
 
 def gpt_neox_6_9b(**over) -> GPTNeoXConfig:
@@ -83,14 +90,10 @@ class GPTNeoXAttention(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv",
         )(x)
-        # NeoX frequencies: inv_freq denominators use rotary_dims, so build
-        # fresh tables here rather than slicing the stack's head_dim tables
-        # (this sits inside the scanned layer body — compiled once)
-        from neuronx_distributed_tpu.models.llama import rotary_embedding
-
-        rd = int(cfg.head_dim_ * cfg.rotary_pct)
-        positions = jnp.arange(x.shape[1], dtype=jnp.int32)
-        cos, sin = rotary_embedding(positions, rd, cfg.rope_theta, dtype=q.dtype)
+        # the stack builds the tables ONCE for cfg.rope_dims (rotary_dims-based
+        # NeoX frequencies) and broadcasts them through the scan
+        cos, sin = rope
+        rd = cfg.rope_dims
         q = apply_partial_rotary(q, cos, sin, rd)
         k = apply_partial_rotary(k, cos, sin, rd)
         s = x.shape[1]
@@ -168,8 +171,8 @@ class GPTNeoXDecoderLayer(nn.Module):
 
 
 class GPTNeoXForCausalLM(LlamaForCausalLM):
-    """The shared embed/scan/head stack with the NeoX decoder block (the
-    stack's full-head-dim rope tables are unused — the NeoX attention builds
-    its own rotary_dims-based tables)."""
+    """The shared embed/scan/head stack with the NeoX decoder block: the
+    stack's rope tables cover ``rope_dims`` (partial rotary) and the final
+    norm is NeoX's biased LayerNorm (``norm_type``)."""
 
     layer_cls: Any = GPTNeoXDecoderLayer

@@ -78,6 +78,27 @@ class LlamaConfig:
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
 
+    @property
+    def rope_dims(self) -> int:
+        """Head dims the rotary tables cover (GPT-NeoX's partial rotary
+        overrides this to ``rotary_pct * head_dim``)."""
+        return self.head_dim_
+
+    def make_final_norm(self, name: Optional[str] = None):
+        """The stack's final norm (GPT-NeoX overrides via ``norm_type``)."""
+        if getattr(self, "norm_type", "rmsnorm") == "layernorm":
+            from neuronx_distributed_tpu.parallel.layers import SPLayerNorm
+
+            return SPLayerNorm(
+                epsilon=getattr(self, "layer_norm_eps", 1e-5), dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                sequence_parallel=self.sequence_parallel, name=name,
+            )
+        return RMSNorm(
+            epsilon=self.rms_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+            sequence_parallel=self.sequence_parallel, name=name,
+        )
+
     def blocks_for(self, sq: int, sk: Optional[int] = None) -> Tuple[int, int]:
         """Flash block sizes: explicit config values, else adaptive — block_q
         keyed on the QUERY length, block_k on the KEY sweep length (``sk``;
@@ -419,10 +440,7 @@ class LlamaModel(nn.Module):
             in_axes=nn.broadcast,
             metadata_params={nn.meta.PARTITION_NAME: None},
         )(cfg, self.layer_cls)
-        self.final_norm = RMSNorm(
-            epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel,
-        )
+        self.final_norm = cfg.make_final_norm()
 
     def __call__(self, input_ids: jax.Array, chunk_ctx=None) -> jax.Array:
         cfg = self.config
@@ -433,7 +451,7 @@ class LlamaModel(nn.Module):
         x = self.embed(input_ids)
         positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
         # cos/sin computed ONCE here (not per scanned layer) and broadcast
-        rope = rotary_embedding(positions, cfg.head_dim_, cfg.rope_theta, dtype=x.dtype)
+        rope = rotary_embedding(positions, cfg.rope_dims, cfg.rope_theta, dtype=x.dtype)
         if cfg.context_parallel:
             if cfg.sequence_parallel:
                 raise ValueError("sequence_parallel and context_parallel are exclusive")

@@ -10,7 +10,10 @@ Subcommands:
   through the HF converter);
 * ``benchmark`` — p50/p90/p95/p99 TTFT + per-token decode latency +
   end-to-end throughput per submodel (context-encoding vs token-gen — the
-  reference reports the same split per model wrapper).
+  reference reports the same split per model wrapper);
+* ``speculate`` — draft-assisted decoding (reference
+  ``run_llama_speculative.py``): pass --draft_layers to build a shallower
+  draft from the same config, or rely on the tiny self-draft demo.
 
 Run (13B dims, TP8):
     python examples/inference/runner.py benchmark --tp 8
@@ -173,10 +176,61 @@ def cmd_benchmark(args) -> None:
     print(json.dumps(report))
 
 
+def cmd_speculate(args) -> None:
+    """Assisted decoding with a shallower draft model (same family/config,
+    fewer layers — the reference's speculative runner pairs a small draft
+    checkpoint with the target the same way)."""
+    import dataclasses
+
+    from neuronx_distributed_tpu.inference.speculative import speculative_generate
+
+    if args.top_k or args.top_p < 1.0:
+        raise SystemExit("speculate supports --sample with --temperature only "
+                         "(top_k/top_p acceptance is not implemented)")
+    lm, cfg = build_model(args)
+    draft_layers = (args.draft_layers if args.draft_layers is not None
+                    else max(1, cfg.num_layers // 4))
+    if not 1 <= draft_layers < cfg.num_layers:
+        raise SystemExit(
+            f"--draft_layers must be in [1, {cfg.num_layers - 1}] "
+            f"(target has {cfg.num_layers} layers), got {draft_layers}"
+        )
+    draft_cfg = dataclasses.replace(cfg, num_layers=draft_layers)
+    # tiny demo: the draft reuses the target's params truncated to its depth
+    draft_params = jax.tree.map(
+        lambda p: p[: draft_cfg.num_layers] if (
+            hasattr(p, "shape") and p.ndim > 0 and p.shape[0] == cfg.num_layers
+        ) else p,
+        lm.params,
+    )
+    draft = CausalLM(draft_cfg, draft_params, LlamaForCausalLM,
+                     buckets=lm.buckets, max_batch=lm.max_batch)
+    rs = np.random.RandomState(args.seed)
+    prompt_len = 16 if args.tiny else 128
+    prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
+    # warmup compiles every program (target/draft prefill+decode, proposer,
+    # chunk verify) OUTSIDE the timed window — cmd_generate's discipline
+    run = lambda n, rng: speculative_generate(  # noqa: E731
+        lm, draft, prompt, max_new_tokens=n,
+        num_draft=args.num_draft, greedy=not args.sample,
+        temperature=args.temperature, rng=rng,
+    )
+    run(2, jax.random.key(args.seed + 1))
+    t0 = time.perf_counter()
+    result = run(args.max_new_tokens, jax.random.key(args.seed))
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "generated": result.tokens[0][: int(result.lengths[0])].tolist(),
+        "tokens_per_sec": round(int(result.lengths[0]) / dt, 1),
+        "draft_layers": draft_cfg.num_layers,
+        "num_draft": args.num_draft,
+    }))
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("generate", "benchmark"):
+    for name in ("generate", "benchmark", "speculate"):
         p = sub.add_parser(name)
         p.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
         p.add_argument("--tiny", action="store_true")
@@ -193,12 +247,15 @@ def main(argv=None) -> None:
         p.add_argument("--top_k", type=int, default=0)
         p.add_argument("--top_p", type=float, default=1.0)
         p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--num_draft", type=int, default=4)
+        p.add_argument("--draft_layers", type=int, default=None)
     args = parser.parse_args(argv)
     if args.tiny:
         from common import force_cpu_mesh
 
         force_cpu_mesh()
-    {"generate": cmd_generate, "benchmark": cmd_benchmark}[args.cmd](args)
+    {"generate": cmd_generate, "benchmark": cmd_benchmark,
+     "speculate": cmd_speculate}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -49,6 +49,16 @@ def mixtral_8x7b(**over) -> MixtralConfig:
     ), **over})
 
 
+def dbrx(**over) -> MixtralConfig:
+    """DBRX dims (reference serves it through the same MoE stack,
+    ``examples/inference/run_dbrx.py``): 16 experts, top-4 routing."""
+    return MixtralConfig(**{**dict(
+        vocab_size=100352, hidden_size=6144, intermediate_size=10752,
+        num_layers=40, num_heads=48, num_kv_heads=8, rope_theta=5e5,
+        num_experts=16, top_k=4,
+    ), **over})
+
+
 class MixtralDecoderLayer(nn.Module):
     config: MixtralConfig
 

@@ -106,3 +106,13 @@ def test_gpt_neox_pretrain_tiny():
 
     loss = gpt_neox_pretrain.main(["--tiny", "--steps", "2", "--log_every", "0"])
     assert np.isfinite(loss)
+
+
+def test_inference_runner_speculate_tiny(capsys):
+    import runner
+
+    runner.main(["speculate", "--tiny", "--max_new_tokens", "6",
+                 "--num_draft", "2", "--draft_layers", "1"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(report["generated"]) == 6
+    assert report["draft_layers"] == 1

@@ -99,8 +99,21 @@ def build_model(args):
     buckets = (64, 128) if args.tiny else tuple(
         b for b in (128, 512, 2048, 4096) if b < cfg.max_seq_len
     )
+    param_transform = None
+    if getattr(args, "quantize", False):
+        # int8 weight-only serving (reference run_llama_quantized.py): the
+        # int8 tree is what HBM holds; dequant runs inside the compiled
+        # programs and fuses into the matmuls
+        from neuronx_distributed_tpu.quantization.core import (
+            dequantize_params,
+            quantize_params,
+        )
+
+        params = quantize_params(params)
+        param_transform = lambda p: dequantize_params(p, cfg.dtype)  # noqa: E731
     lm = CausalLM(cfg, params, LlamaForCausalLM,
-                  buckets=buckets, max_batch=args.max_batch)
+                  buckets=buckets, max_batch=args.max_batch,
+                  param_transform=param_transform)
     return lm, cfg
 
 
@@ -204,7 +217,8 @@ def cmd_speculate(args) -> None:
         lm.params,
     )
     draft = CausalLM(draft_cfg, draft_params, LlamaForCausalLM,
-                     buckets=lm.buckets, max_batch=lm.max_batch)
+                     buckets=lm.buckets, max_batch=lm.max_batch,
+                     param_transform=lm.param_transform)
     rs = np.random.RandomState(args.seed)
     prompt_len = 16 if args.tiny else 128
     prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
@@ -249,6 +263,8 @@ def main(argv=None) -> None:
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--num_draft", type=int, default=4)
         p.add_argument("--draft_layers", type=int, default=None)
+        p.add_argument("--quantize", action="store_true",
+                       help="serve int8 weight-only quantized params")
     args = parser.parse_args(argv)
     if args.tiny:
         from common import force_cpu_mesh

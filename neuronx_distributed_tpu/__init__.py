@@ -14,4 +14,22 @@ from neuronx_distributed_tpu.parallel.mesh import (  # noqa: F401
     destroy_model_parallel,
 )
 
+# top-level API parity with the reference package root
+# (src/neuronx_distributed/__init__.py:2-8 re-exports the checkpoint + trainer
+# surface as `nxd.*`)
+from neuronx_distributed_tpu.checkpoint import (  # noqa: F401
+    finalize_checkpoint,
+    has_checkpoint,
+    latest_tag,
+    load_checkpoint,
+    save_checkpoint,
+)
+from neuronx_distributed_tpu.trainer import (  # noqa: F401
+    create_train_state,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+    neuronx_distributed_config,
+)
+
 __version__ = "0.1.0"

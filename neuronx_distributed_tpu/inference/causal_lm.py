@@ -96,6 +96,7 @@ class CausalLM:
         model_cls,
         buckets: Tuple[int, ...] = (128, 512, 2048),
         max_batch: int = 4,
+        param_transform=None,
     ):
         # keep the caller's use_flash_attention: prefill buckets >= 128 run
         # the Pallas kernel with position masks (reference prefill gating,
@@ -105,6 +106,12 @@ class CausalLM:
         )
         self.params = params
         self.max_batch = max_batch
+        # applied INSIDE every compiled program (e.g. int8 dequantization —
+        # the quantized weights are what lives in HBM and XLA fuses the
+        # dequant multiply into the consuming matmuls; reference serves
+        # quantized checkpoints through its QuantizedParallel layers,
+        # run_llama_quantized.py)
+        self.param_transform = param_transform
         self.buckets = tuple(sorted(b for b in buckets if b <= self.config.max_seq_len))
         if not self.buckets:
             raise ValueError(f"no bucket fits max_seq_len {self.config.max_seq_len}")
@@ -115,13 +122,17 @@ class CausalLM:
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
     def compile(self) -> "CausalLM":
+        def resolve(params):
+            return self.param_transform(params) if self.param_transform else params
+
         def prefill_fn(params, ids):
-            logits, mut = self.model.apply({"params": params}, ids, mutable=["cache"])
+            logits, mut = self.model.apply({"params": resolve(params)}, ids,
+                                           mutable=["cache"])
             return logits, mut["cache"]
 
         def decode_fn(params, cache, ids):
             logits, mut = self.model.apply(
-                {"params": params, "cache": cache}, ids, mutable=["cache"]
+                {"params": resolve(params), "cache": cache}, ids, mutable=["cache"]
             )
             return logits, mut["cache"]
 
@@ -160,6 +171,8 @@ class CausalLM:
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
         def prefill_shape(params, ids):
+            if self.param_transform is not None:  # e.g. int8 dequantization
+                params = self.param_transform(params)
             _, mut = self.model.apply({"params": params}, ids, mutable=["cache"])
             return mut["cache"]
 

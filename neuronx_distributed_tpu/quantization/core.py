@@ -48,7 +48,17 @@ def _is_target(pstr: str, cfg: QuantizationConfig) -> bool:
 
 
 class QuantizedLeaf(dict):
-    """Marker dict {'qweight', 'scale'} so trees round-trip through pytrees."""
+    """Marker dict {'qweight', 'scale'} so trees round-trip through pytrees.
+    Registered as a pytree node (dict SUBCLASSES are not automatic) so
+    quantized trees can be jit arguments — int8 weights live in HBM and the
+    in-program dequant fuses into the consuming matmuls."""
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedLeaf,
+    lambda d: (tuple(d[k] for k in sorted(d)), tuple(sorted(d))),
+    lambda keys, vals: QuantizedLeaf(zip(keys, vals)),
+)
 
 
 def quantize_params(params: PyTree, config: Optional[QuantizationConfig] = None) -> PyTree:

@@ -121,18 +121,21 @@ class CausalLM:
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
-    def compile(self) -> "CausalLM":
-        def resolve(params):
-            return self.param_transform(params) if self.param_transform else params
+    def _resolve(self, params):
+        """The single place the serving param transform applies (e.g. int8
+        dequantization) — every compiled program must route through it."""
+        return self.param_transform(params) if self.param_transform else params
 
+    def compile(self) -> "CausalLM":
         def prefill_fn(params, ids):
-            logits, mut = self.model.apply({"params": resolve(params)}, ids,
+            logits, mut = self.model.apply({"params": self._resolve(params)}, ids,
                                            mutable=["cache"])
             return logits, mut["cache"]
 
         def decode_fn(params, cache, ids):
             logits, mut = self.model.apply(
-                {"params": resolve(params), "cache": cache}, ids, mutable=["cache"]
+                {"params": self._resolve(params), "cache": cache}, ids,
+                mutable=["cache"]
             )
             return logits, mut["cache"]
 
@@ -171,9 +174,8 @@ class CausalLM:
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
         def prefill_shape(params, ids):
-            if self.param_transform is not None:  # e.g. int8 dequantization
-                params = self.param_transform(params)
-            _, mut = self.model.apply({"params": params}, ids, mutable=["cache"])
+            _, mut = self.model.apply({"params": self._resolve(params)}, ids,
+                                      mutable=["cache"])
             return mut["cache"]
 
         cache = jax.eval_shape(prefill_shape, self.params, ids0)

@@ -46,10 +46,9 @@ def _make_proposer(draft: CausalLM, num_draft: int, greedy: bool, temperature: f
     steps) — kills the per-token host round-trip of v1."""
 
     def fwd(params, cache, tok):
-        if draft.param_transform is not None:  # quantized draft serving
-            params = draft.param_transform(params)
         logits, mut = draft.model.apply(
-            {"params": params, "cache": cache}, tok, mutable=["cache"]
+            {"params": draft._resolve(params), "cache": cache}, tok,
+            mutable=["cache"]
         )
         return logits[:, 0].astype(jnp.float32), mut["cache"]
 
@@ -134,10 +133,9 @@ def speculative_generate(
 
     # chunked verify program on the target: γ+1 tokens at the current index
     def chunk_fn(params, cache, ids):
-        if target.param_transform is not None:  # quantized target serving
-            params = target.param_transform(params)
         logits, mut = target.model.apply(
-            {"params": params, "cache": cache}, ids, mutable=["cache"]
+            {"params": target._resolve(params), "cache": cache}, ids,
+            mutable=["cache"]
         )
         return logits, mut["cache"]
 

@@ -46,7 +46,31 @@ from neuronx_distributed_tpu.utils import get_logger
 logger = get_logger("nxd.examples.inference")
 
 
-def build_config(args) -> LlamaConfig:
+def _model_cls(args):
+    """Model family selector (reference ships run_llama.py / run_mixtral.py /
+    run_dbrx.py as separate scripts; one flag here)."""
+    if args.model in ("mixtral", "dbrx"):
+        from neuronx_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+        return MixtralForCausalLM
+    return LlamaForCausalLM
+
+
+def build_config(args):
+    family = args.model
+    if family in ("mixtral", "dbrx"):
+        from neuronx_distributed_tpu.models.mixtral import MixtralConfig, dbrx, mixtral_8x7b
+
+        if args.tiny:
+            return MixtralConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+                num_heads=4, num_kv_heads=4, max_seq_len=256, dtype=jnp.float32,
+                use_flash_attention=False, num_experts=4, top_k=2,
+                selective_loading_threshold=1.5,
+            )
+        preset = dbrx if family == "dbrx" else mixtral_8x7b
+        return preset(max_seq_len=args.max_seq_len, dtype=jnp.bfloat16,
+                      param_dtype=jnp.bfloat16, remat_policy=None)
     if args.tiny:
         return LlamaConfig(
             vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
@@ -66,6 +90,11 @@ def build_model(args):
     )
     ids = jnp.zeros((1, 8), jnp.int32)
     if args.hf_checkpoint:
+        if args.model != "llama":
+            raise SystemExit(
+                "--hf_checkpoint currently supports --model llama only "
+                "(converters/hf_llama.py covers the Llama family)"
+            )
         import dataclasses
 
         from flax import linen as nn
@@ -94,7 +123,7 @@ def build_model(args):
         params = hf_to_nxd_llama(load_hf_safetensors(args.hf_checkpoint), cfg)
         params = jax.device_put(params, specs_to_shardings(specs, ps.get_mesh()))
     else:
-        model = initialize_parallel_model(nxd_config, lambda: LlamaForCausalLM(cfg), ids)
+        model = initialize_parallel_model(nxd_config, lambda: _model_cls(args)(cfg), ids)
         params = model.params
     buckets = (64, 128) if args.tiny else tuple(
         b for b in (128, 512, 2048, 4096) if b < cfg.max_seq_len
@@ -111,7 +140,7 @@ def build_model(args):
 
         params = quantize_params(params)
         param_transform = lambda p: dequantize_params(p, cfg.dtype)  # noqa: E731
-    lm = CausalLM(cfg, params, LlamaForCausalLM,
+    lm = CausalLM(cfg, params, _model_cls(args),
                   buckets=buckets, max_batch=args.max_batch,
                   param_transform=param_transform)
     return lm, cfg
@@ -178,7 +207,7 @@ def cmd_benchmark(args) -> None:
         decode.append(time.perf_counter() - t0)
 
     report = {
-        "model": "llama2_13b_dims" if not args.tiny else "tiny",
+        "model": args.model + ("_tiny" if args.tiny else ""),
         "tp": args.tensor_parallel_size or (2 if args.tiny else 8),
         "batch": lm.max_batch,
         "prompt_len": prompt_len,
@@ -216,7 +245,7 @@ def cmd_speculate(args) -> None:
         ) else p,
         lm.params,
     )
-    draft = CausalLM(draft_cfg, draft_params, LlamaForCausalLM,
+    draft = CausalLM(draft_cfg, draft_params, _model_cls(args),
                      buckets=lm.buckets, max_batch=lm.max_batch,
                      param_transform=lm.param_transform)
     rs = np.random.RandomState(args.seed)
@@ -265,6 +294,8 @@ def main(argv=None) -> None:
         p.add_argument("--draft_layers", type=int, default=None)
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")
+        p.add_argument("--model", choices=["llama", "mixtral", "dbrx"],
+                       default="llama")
     args = parser.parse_args(argv)
     if args.tiny:
         from common import force_cpu_mesh

@@ -116,3 +116,14 @@ def test_inference_runner_speculate_tiny(capsys):
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert len(report["generated"]) == 6
     assert report["draft_layers"] == 1
+
+
+def test_inference_runner_mixtral_tiny(capsys):
+    """MoE serving through the shared runner (reference run_mixtral.py):
+    decode steps hit the selective-loading expert path."""
+    import runner
+
+    runner.main(["generate", "--tiny", "--model", "mixtral",
+                 "--max_new_tokens", "4"])
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines[0]["generated"]) == 4

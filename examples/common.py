@@ -32,11 +32,15 @@ from neuronx_distributed_tpu.utils.profiler import profile_steps, step_annotatio
 logger = get_logger("nxd.examples")
 
 
-def force_cpu_mesh(n_devices: int = 8) -> None:
+def force_cpu_mesh(n_devices: int = 8, check: bool = True) -> None:
     """Self-provision a virtual CPU device mesh for ``--tiny`` runs (same
     pattern as ``__graft_entry__.dryrun_multichip``): this image's
     sitecustomize pins ``JAX_PLATFORMS`` to the TPU plugin at interpreter
-    start, so the env var alone is too late — switch via jax.config too."""
+    start, so the env var alone is too late — switch via jax.config too.
+
+    ``check=False`` skips the device-count probe, which initializes the XLA
+    backend — required when ``jax.distributed.initialize`` (setup_distributed)
+    still has to run, since that must precede any backend use."""
     import os
 
     flags = os.environ.get("XLA_FLAGS", "")
@@ -45,7 +49,7 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
     jax.config.update("jax_platforms", "cpu")
-    if len(jax.devices()) < n_devices:
+    if check and len(jax.devices()) < n_devices:
         raise RuntimeError(
             f"virtual CPU mesh has {len(jax.devices())} devices (< {n_devices}); "
             "jax was already initialized on another platform — set "
@@ -57,6 +61,12 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
 def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
     parser.add_argument("--pipeline_parallel_size", "--pp", type=int, default=None)
+    # pod launch trio (reference torchrun --master_addr/--nnodes/--node_rank);
+    # the NXD_* env vars work too — see scripts/launch_pod.sh
+    parser.add_argument("--coordinator_address", type=str, default=None,
+                        help="host0:port of the pod coordinator (multi-host)")
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
     parser.add_argument("--batch_size", type=int, default=None)
     parser.add_argument("--seq_len", type=int, default=None)
     parser.add_argument("--steps", type=int, default=None)
@@ -76,6 +86,63 @@ def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
         help="shrink the model/batch to CI scale (virtual CPU mesh smoke)",
     )
     return parser
+
+
+def setup_distributed(args) -> bool:
+    """Join the pod runtime when the launch trio is present (call before any
+    mesh/model init). Returns True on a multi-process run. Safe to call
+    unconditionally — single-host runs are a no-op, mirroring how every
+    reference example unconditionally does ``init_process_group``."""
+    from neuronx_distributed_tpu.parallel.distributed import initialize_distributed
+
+    multi = initialize_distributed(
+        coordinator_address=getattr(args, "coordinator_address", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None),
+    )
+    if multi:
+        logger.info("pod process %d/%d (%d local devices)",
+                    jax.process_index(), jax.process_count(),
+                    jax.local_device_count())
+    return multi
+
+
+def setup_example(args, n_devices: int = 8) -> bool:
+    """Standard example bootstrap, in the one order that works: platform
+    switch for ``--tiny`` (no backend probe), THEN the pod join —
+    ``jax.distributed.initialize`` must precede any backend use — then the
+    device-count sanity check. Returns True on a multi-process run."""
+    if getattr(args, "tiny", False):
+        force_cpu_mesh(n_devices, check=False)
+    multi = setup_distributed(args)
+    if getattr(args, "tiny", False) and len(jax.local_devices()) < 2:
+        raise SystemExit(
+            "tiny smoke needs a multi-device CPU mesh; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}")
+    return multi
+
+
+def distribute_batches(batches: Iterator[Dict[str, np.ndarray]],
+                       global_batch: int) -> Iterator[Dict[str, np.ndarray]]:
+    """Make a synthetic GLOBAL-batch iterator pod-correct: on a multi-process
+    run each host keeps only its row slice (identical global generation from
+    the shared seed); single-process this is a passthrough."""
+    if jax.process_count() == 1:
+        return batches
+    return host_local_batches(batches, global_batch)
+
+
+def host_local_batches(batches: Iterator[Dict[str, np.ndarray]],
+                       global_batch: int) -> Iterator[Dict[str, np.ndarray]]:
+    """Slice a GLOBAL-batch iterator down to this host's rows (processes
+    generate identical global batches from the shared seed, then each keeps
+    its slice — train_loop reassembles via shard_host_batch). Real corpora
+    skip this: TokenShardDataset shards at the source via rank/world_size."""
+    from neuronx_distributed_tpu.parallel.distributed import host_batch_slice
+
+    sl = host_batch_slice(global_batch)
+    for b in batches:
+        yield {k: v[sl] for k, v in b.items()}
 
 
 def synthetic_lm_batches(vocab_size: int, batch: int, seq: int,
@@ -140,10 +207,20 @@ def train_loop(
     writer = MetricsWriter(metrics_file)
     metrics = {}
     last_logged = start_step
+    # Multi-host: each process's iterator yields its LOCAL rows; assemble the
+    # global DP-sharded batch before the step (reference DistributedSampler +
+    # DDP input scatter role). Single-host the raw numpy feeds jit directly
+    # ON PURPOSE: make_array_from_process_local_data requires the batch to
+    # divide evenly over the DP axes, while jit on raw numpy tolerates uneven
+    # shardings (GSPMD pads) — single-host keeps the laxer contract.
+    if jax.process_count() > 1:
+        from neuronx_distributed_tpu.parallel.distributed import shard_host_batch
+    else:
+        shard_host_batch = lambda b: b  # noqa: E731
     try:
         with profile_steps(profile_dir):
             for i in range(start_step, steps):
-                batch = next(batches)
+                batch = shard_host_batch(next(batches))
                 with step_annotation(i):
                     state, metrics = step_fn(state, batch, jax.random.key(seed + i + 1))
                 if log_every and ((i + 1) % log_every == 0 or i + 1 == steps):

@@ -23,7 +23,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import jax.numpy as jnp
 
-from common import add_common_args, maybe_resume, synthetic_mlm_batches, train_loop
+from common import (
+    add_common_args,
+    distribute_batches,
+    maybe_resume,
+    setup_example,
+    synthetic_mlm_batches,
+    train_loop,
+)
 from neuronx_distributed_tpu.models.bert import BertConfig, BertForPreTraining, bert_large
 from neuronx_distributed_tpu.trainer import (
     create_train_state,
@@ -47,10 +54,7 @@ def build_config(args) -> BertConfig:
 def main(argv=None) -> float:
     parser = add_common_args(argparse.ArgumentParser(description=__doc__))
     args = parser.parse_args(argv)
-    if args.tiny:
-        from common import force_cpu_mesh
-
-        force_cpu_mesh()
+    setup_example(args)
     tp = args.tensor_parallel_size or (2 if args.tiny else 8)
     batch = args.batch_size or (4 if args.tiny else 16)
     seq = args.seq_len or (32 if args.tiny else 512)
@@ -62,7 +66,8 @@ def main(argv=None) -> float:
         optimizer_config={"zero_one_enabled": True},
         mixed_precision_config={"use_master_weights": True},
     )
-    batches = synthetic_mlm_batches(bcfg.vocab_size, batch, seq, seed=args.seed)
+    batches = distribute_batches(
+        synthetic_mlm_batches(bcfg.vocab_size, batch, seq, seed=args.seed), batch)
     sample = next(batches)
     model = initialize_parallel_model(
         nxd_config, lambda: BertForPreTraining(bcfg), sample["input_ids"]

@@ -20,7 +20,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import jax.numpy as jnp
 
-from common import add_common_args, maybe_resume, synthetic_lm_batches, train_loop
+from common import (
+    add_common_args,
+    distribute_batches,
+    maybe_resume,
+    setup_example,
+    synthetic_lm_batches,
+    train_loop,
+)
 from neuronx_distributed_tpu.models.gpt_neox import (
     GPTNeoXConfig,
     GPTNeoXForCausalLM,
@@ -54,10 +61,7 @@ def main(argv=None) -> float:
     parser = add_common_args(argparse.ArgumentParser(description=__doc__))
     parser.add_argument("--size", choices=["6.9b", "20b"], default="6.9b")
     args = parser.parse_args(argv)
-    if args.tiny:
-        from common import force_cpu_mesh
-
-        force_cpu_mesh()
+    setup_example(args)
     tp = args.tensor_parallel_size or (2 if args.tiny else 8)
     batch = args.batch_size or (4 if args.tiny else 8)
     seq = args.seq_len or (32 if args.tiny else 2048)
@@ -70,7 +74,8 @@ def main(argv=None) -> float:
         optimizer_config={"zero_one_enabled": True},
         mixed_precision_config={"use_master_weights": True},
     )
-    batches = synthetic_lm_batches(ncfg.vocab_size, batch, seq, seed=args.seed)
+    batches = distribute_batches(
+        synthetic_lm_batches(ncfg.vocab_size, batch, seq, seed=args.seed), batch)
     sample = next(batches)
     model = initialize_parallel_model(
         nxd_config, lambda: GPTNeoXForCausalLM(ncfg), sample["ids"]

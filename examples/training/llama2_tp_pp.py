@@ -24,7 +24,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import jax.numpy as jnp
 
-from common import add_common_args, maybe_resume, synthetic_lm_batches, train_loop
+from common import (
+    add_common_args,
+    distribute_batches,
+    maybe_resume,
+    setup_example,
+    synthetic_lm_batches,
+    train_loop,
+)
 from neuronx_distributed_tpu.models.llama import LlamaConfig, llama2_70b
 from neuronx_distributed_tpu.models.llama_pipeline import PipelinedLlama
 from neuronx_distributed_tpu.parallel import mesh as ps
@@ -55,10 +62,7 @@ def main(argv=None) -> float:
     parser.add_argument("--num_chunks", type=int, default=1,
                         help="virtual-pipeline (interleaved) chunks per stage")
     args = parser.parse_args(argv)
-    if args.tiny:
-        from common import force_cpu_mesh
-
-        force_cpu_mesh()
+    setup_example(args)
     tp = args.tensor_parallel_size or (2 if args.tiny else 8)
     pp = args.pipeline_parallel_size or (2 if args.tiny else 8)
     batch = args.batch_size or (4 if args.tiny else 32)
@@ -78,7 +82,8 @@ def main(argv=None) -> float:
         ps.initialize_model_parallel(
             tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp
         )
-    batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
+    batches = distribute_batches(
+        synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed), batch)
     sample = next(batches)
     pmodel = PipelinedLlama(lcfg, num_stages=pp, num_microbatches=num_mb,
                             num_chunks=args.num_chunks)

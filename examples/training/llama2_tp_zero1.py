@@ -9,6 +9,13 @@ Run (full scale):
     python examples/training/llama2_tp_zero1.py --tp 8 --steps 100
 CI smoke:
     python examples/training/llama2_tp_zero1.py --tiny --steps 4
+Pod launch (reference ``run_llama2_70B_tp_pp.sh`` torchrun role — every host
+runs the same command; see ``scripts/launch_pod.sh``):
+    # on host i of N:
+    python examples/training/llama2_tp_zero1.py --tp 8 --steps 100 \
+        --coordinator_address host0:8476 --num_processes N --process_id i
+``--batch_size`` is the GLOBAL batch; each host feeds batch/N rows
+(TokenShardDataset rank/world sharding, or the synthetic slice).
 """
 
 from __future__ import annotations
@@ -21,7 +28,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import jax.numpy as jnp
 
-from common import add_common_args, maybe_resume, synthetic_lm_batches, train_loop
+from common import (
+    add_common_args,
+    distribute_batches,
+    maybe_resume,
+    setup_example,
+    synthetic_lm_batches,
+    train_loop,
+)
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama2_7b
 from neuronx_distributed_tpu.trainer import (
     create_train_state,
@@ -53,12 +67,15 @@ def main(argv=None) -> float:
                         help="token-shard files (data.TokenShardDataset); "
                              "default: hermetic synthetic batches")
     args = parser.parse_args(argv)
-    if args.tiny:
-        from common import force_cpu_mesh
+    setup_example(args)
+    import jax
 
-        force_cpu_mesh()
+    n_hosts = jax.process_count()
     tp = args.tensor_parallel_size or (2 if args.tiny else 8)
-    batch = args.batch_size or (4 if args.tiny else 8)
+    batch = args.batch_size or (4 if args.tiny else 8)  # GLOBAL batch
+    if batch % n_hosts:
+        raise SystemExit(f"--batch_size {batch} not divisible by {n_hosts} hosts")
+    local_batch = batch // n_hosts
     seq = args.seq_len or (32 if args.tiny else 4096)
     steps = args.steps or (4 if args.tiny else 100)
     if args.shard_glob:
@@ -67,8 +84,9 @@ def main(argv=None) -> float:
         from neuronx_distributed_tpu.data import TokenShardDataset
 
         shard_paths = sorted(_glob.glob(args.shard_glob))
-        ds = TokenShardDataset(shard_paths, batch_size=batch,
-                               shuffle_seed=args.seed)
+        ds = TokenShardDataset(shard_paths, batch_size=local_batch,
+                               shuffle_seed=args.seed,
+                               rank=jax.process_index(), world_size=n_hosts)
         seq = ds.seq_len  # the shards define the sequence length
 
     lcfg = build_config(args, seq)
@@ -82,7 +100,8 @@ def main(argv=None) -> float:
     if args.shard_glob:
         batches = iter(ds)
     else:
-        batches = synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed)
+        batches = distribute_batches(
+            synthetic_lm_batches(lcfg.vocab_size, batch, seq, seed=args.seed), batch)
     sample = next(batches)
     model = initialize_parallel_model(
         nxd_config, lambda: LlamaForCausalLM(lcfg), sample["ids"]

@@ -13,6 +13,10 @@ from neuronx_distributed_tpu.parallel.mesh import (  # noqa: F401
     model_parallel_is_initialized,
     destroy_model_parallel,
 )
+from neuronx_distributed_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+    shard_host_batch,
+)
 
 # top-level API parity with the reference package root
 # (src/neuronx_distributed/__init__.py:2-8 re-exports the checkpoint + trainer

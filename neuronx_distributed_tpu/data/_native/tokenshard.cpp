@@ -45,9 +45,15 @@ struct Reader {
   uint64_t batch = 0;
   uint64_t total_seqs = 0;
   std::vector<uint64_t> order;      // global sequence permutation
-  uint64_t cursor = 0;              // next position in `order` (epoch wraps)
+  uint64_t cursor = 0;              // next per-rank position (epoch wraps)
   uint64_t seed = 0;
   uint64_t epoch = 0;
+  // process sharding (DistributedSampler role): rank r reads positions
+  // r, r+world, r+2*world, ... of the epoch permutation; the remainder
+  // (total % world) is dropped so every rank sees the same count per epoch.
+  uint64_t rank = 0;
+  uint64_t world = 1;
+  uint64_t per_rank = 0;
 
   // prefetch machinery
   std::deque<std::vector<int32_t>> queue;
@@ -80,12 +86,13 @@ struct Reader {
   void fill_batch(std::vector<int32_t>& out) {
     out.resize(batch * seq_len);
     for (uint64_t b = 0; b < batch; ++b) {
-      if (cursor >= total_seqs) {  // epoch boundary: reshuffle + wrap
+      if (cursor >= per_rank) {  // epoch boundary: reshuffle + wrap
         cursor = 0;
         ++epoch;
         reshuffle();
       }
-      const int32_t* src = seq_ptr(order[cursor++]);
+      const int32_t* src = seq_ptr(order[cursor * world + rank]);
+      ++cursor;
       std::memcpy(out.data() + b * seq_len, src, seq_len * sizeof(int32_t));
     }
   }
@@ -138,12 +145,18 @@ bool map_shard(const char* path, uint64_t expect_seq_len, Shard* out) {
 extern "C" {
 
 // Returns an opaque handle (heap Reader*), or nullptr on failure.
+// rank/world shard the epoch permutation across processes (world=1: no
+// sharding); requires rank < world and total_seqs >= world.
 void* tsr_open(const char** paths, int n_paths, uint64_t seq_len,
-               uint64_t batch, uint64_t shuffle_seed) {
+               uint64_t batch, uint64_t shuffle_seed,
+               uint64_t rank, uint64_t world) {
+  if (world == 0 || rank >= world) return nullptr;
   auto* r = new Reader();
   r->seq_len = seq_len;
   r->batch = batch;
   r->seed = shuffle_seed;
+  r->rank = rank;
+  r->world = world;
   for (int i = 0; i < n_paths; ++i) {
     Shard s;
     if (!map_shard(paths[i], seq_len, &s)) {
@@ -154,7 +167,8 @@ void* tsr_open(const char** paths, int n_paths, uint64_t seq_len,
     r->total_seqs += s.num_seqs;
     r->shards.push_back(s);
   }
-  if (r->total_seqs == 0) {
+  r->per_rank = r->total_seqs / r->world;
+  if (r->per_rank == 0) {
     for (Shard& sh : r->shards) munmap(sh.map, sh.map_len);
     delete r;
     return nullptr;

@@ -67,7 +67,8 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(so)
         lib.tsr_open.restype = ctypes.c_void_p
         lib.tsr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-                                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+                                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                                 ctypes.c_uint64, ctypes.c_uint64]
         lib.tsr_next.restype = ctypes.c_int
         lib.tsr_next.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_int32)]
@@ -89,12 +90,23 @@ class TokenShardDataset:
 
     def __init__(self, paths: Sequence[str], batch_size: int,
                  shuffle: bool = True, shuffle_seed: int = 0,
-                 ignore_index: int = -100, native: Optional[bool] = None):
+                 ignore_index: int = -100, native: Optional[bool] = None,
+                 rank: int = 0, world_size: int = 1):
+        """``rank``/``world_size`` shard the epoch permutation across
+        processes (the reference examples' ``DistributedSampler`` role):
+        rank r reads positions r, r+world, r+2·world, … of each epoch's
+        shuffled order; the remainder ``total % world`` is dropped so every
+        rank yields the same number of rows per epoch. Pass
+        ``jax.process_index()`` / ``jax.process_count()`` on a pod."""
         self.paths = list(paths)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.shuffle_seed = shuffle_seed
         self.ignore_index = ignore_index
+        if world_size < 1 or not (0 <= rank < world_size):
+            raise ValueError(f"bad rank/world_size: {rank}/{world_size}")
+        self.rank = rank
+        self.world_size = world_size
         if not self.paths:
             raise ValueError("no shard paths")
         with open(self.paths[0], "rb") as fh:
@@ -102,6 +114,17 @@ class TokenShardDataset:
         if header["magic"] != _MAGIC:
             raise ValueError(f"{self.paths[0]}: not a token shard")
         self.seq_len = int(header["seq_len"])
+        # validate shardability up front (headers are cheap) so both backends
+        # fail with the same actionable message, not the native reader's
+        # opaque nullptr
+        total = 0
+        for p in self.paths:
+            hdr = np.fromfile(p, _HEADER, count=1)
+            if hdr.size:
+                total += int(hdr[0]["num_seqs"])
+        if total < world_size:
+            raise ValueError(
+                f"{total} sequences cannot shard across {world_size} ranks")
         lib = _load_native() if native in (None, True) else None
         if native is True and lib is None:
             raise RuntimeError("native reader requested but g++ build failed")
@@ -133,7 +156,8 @@ class TokenShardDataset:
         c_paths = (ctypes.c_char_p * len(self.paths))(
             *[p.encode() for p in self.paths])
         handle = lib.tsr_open(c_paths, len(self.paths), self.seq_len,
-                              self.batch_size, self._native_seed)
+                              self.batch_size, self._native_seed,
+                              self.rank, self.world_size)
         if not handle:
             raise RuntimeError(f"tsr_open failed for {self.paths}")
         out = np.empty((self.batch_size, self.seq_len), np.int32)
@@ -177,14 +201,18 @@ class TokenShardDataset:
             return np.random.RandomState(
                 self.shuffle_seed + epoch).permutation(total)
 
+        per_rank = total // self.world_size
+        if per_rank == 0:
+            raise ValueError(
+                f"{total} sequences cannot shard across {self.world_size} ranks")
         epoch, cursor = 0, 0
         order = make_order(epoch)
         while True:
             ids = np.empty((self.batch_size, self.seq_len), np.int32)
             for row in range(self.batch_size):
-                if cursor >= total:
+                if cursor >= per_rank:
                     cursor, epoch = 0, epoch + 1
                     order = make_order(epoch)
-                ids[row] = lookup(int(order[cursor]))
+                ids[row] = lookup(int(order[cursor * self.world_size + self.rank]))
                 cursor += 1
             yield self._to_batch(ids)

@@ -121,7 +121,18 @@ def initialize_model_parallel(
     # contract as the reference's rank tensor (parallel_state.py:245-261).
     # On real TPU slices jax.devices() is ordered so that neighbors in the
     # flat list are ICI neighbors; keeping TP fastest-varying places each TP
-    # group on adjacent chips.
+    # group on adjacent chips. Multi-host, jax.devices() orders by process
+    # then local device, so TP stays within a host (ICI, never DCN) as long
+    # as it fits in the per-host device count — same constraint the
+    # reference documents for its TP groups.
+    if devices is None and jax.process_count() > 1:
+        local = jax.local_device_count()
+        if tp * cp > local:
+            logger.warning(
+                "tp(%d) * cp(%d) exceeds the %d local devices per host: "
+                "tensor/context collectives will cross hosts over DCN — "
+                "expect a severe bandwidth cliff; prefer tp*cp <= %d",
+                tp, cp, local, local)
     mesh_devices = np.asarray(devs, dtype=object).reshape(pp, edp, ep, cp, tp)
     mesh = Mesh(mesh_devices, MESH_AXES)
 

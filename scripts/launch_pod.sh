@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Pod launcher: run the SAME training command on every host of a TPU pod.
+#
+# Role-parity with the reference's torchrun wrapper
+# (/root/reference/examples/training/llama/tp_pp_llama_hf_pretrain/
+#  run_llama2_70B_tp_pp.sh — torchrun --nnodes --node_rank --master_addr ...):
+# on TPU there is no per-device process fan-out; every HOST runs one
+# single-controller process and jax.distributed wires them together.
+#
+# Usage, on host $I of $N (host 0 is the coordinator):
+#   NXD_COORDINATOR_ADDRESS=host0:8476 NXD_NUM_PROCESSES=$N NXD_PROCESS_ID=$I \
+#     scripts/launch_pod.sh examples/training/llama2_tp_zero1.py --tp 8 --steps 100
+#
+# On Cloud TPU pod VMs the three variables can be derived from the metadata
+# the runtime already exposes (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID), which
+# this script does automatically when they are unset; with gcloud, wrap as:
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#     --command="cd $REPO && scripts/launch_pod.sh examples/training/llama2_tp_zero1.py --tp 8"
+set -euo pipefail
+
+if [[ $# -lt 1 ]]; then
+  echo "usage: scripts/launch_pod.sh <training_script.py> [args...]" >&2
+  exit 2
+fi
+
+# Derive the launch trio from Cloud TPU metadata when not given explicitly.
+if [[ -z "${NXD_COORDINATOR_ADDRESS:-}" && -n "${TPU_WORKER_HOSTNAMES:-}" ]]; then
+  IFS=',' read -ra HOSTS <<<"$TPU_WORKER_HOSTNAMES"
+  if [[ ${#HOSTS[@]} -gt 1 ]]; then
+    export NXD_COORDINATOR_ADDRESS="${HOSTS[0]}:8476"
+    export NXD_NUM_PROCESSES="${#HOSTS[@]}"
+    export NXD_PROCESS_ID="${TPU_WORKER_ID:?TPU_WORKER_ID must be set on pod workers}"
+  fi
+fi
+
+echo "launch_pod: process ${NXD_PROCESS_ID:-0}/${NXD_NUM_PROCESSES:-1}" \
+     "coordinator=${NXD_COORDINATOR_ADDRESS:-<single-host>}" >&2
+exec python "$@"

@@ -107,5 +107,47 @@ def test_corrupt_num_seqs_rejected(tmp_path):
 
     lib = _load_native()
     c_paths = (ctypes.c_char_p * 1)(p.encode())
-    handle = lib.tsr_open(c_paths, 1, 16, 2, 0)
+    handle = lib.tsr_open(c_paths, 1, 16, 2, 0, 0, 1)
     assert not handle  # rejected cleanly
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_rank_sharding_partitions_epoch(shards, native):
+    """rank/world sharding (DistributedSampler role): the two ranks' rows are
+    disjoint and their union is the full epoch, on both backends."""
+    if native and shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    paths, rows = shards  # 16 total rows
+    per_rank_batches = 2  # 2 ranks x (2 batches x 4 rows) = 16 = one epoch
+    seen = {}
+    for rank in (0, 1):
+        ds = TokenShardDataset(paths, batch_size=4, shuffle=False,
+                               native=native, rank=rank, world_size=2)
+        it = iter(ds)
+        got = np.concatenate([next(it)["ids"] for _ in range(per_rank_batches)])
+        seen[rank] = {tuple(r) for r in got.tolist()}
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == {tuple(r) for r in rows.tolist()}
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_rank_sharding_drops_remainder(shards, native):
+    """world=3 over 16 rows: every rank yields 5 rows/epoch, remainder dropped."""
+    if native and shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    paths, rows = shards
+    all_seen = set()
+    for rank in range(3):
+        ds = TokenShardDataset(paths, batch_size=5, shuffle=False,
+                               native=native, rank=rank, world_size=3)
+        got = next(iter(ds))["ids"]  # exactly one per-rank epoch
+        all_seen |= {tuple(r) for r in got.tolist()}
+    assert len(all_seen) == 15  # 16 rows, one dropped
+
+
+def test_bad_rank_world_rejected(shards):
+    paths, _ = shards
+    with pytest.raises(ValueError):
+        TokenShardDataset(paths, batch_size=2, rank=2, world_size=2)
+    with pytest.raises(ValueError):
+        TokenShardDataset(paths, batch_size=2, rank=0, world_size=0)

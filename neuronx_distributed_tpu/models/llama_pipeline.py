@@ -41,6 +41,7 @@ from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, constrain
 from neuronx_distributed_tpu.pipeline.engine import (
     microbatch,
     pipeline,
+    pipeline_1f1b,
     pipeline_interleaved,
     pipeline_scalars,
     vpp_layer_order,
@@ -64,9 +65,16 @@ class PipelinedLlama:
     num_microbatches: int
     remat: bool = True
     num_chunks: int = 1
+    # training schedule for the loss path: "1f1b" (reference default,
+    # Train1F1BSchedule — bounded activation stash) or "gpipe" (autodiff'd
+    # forward scan — simpler program, activations grow with microbatches).
+    # VPP (num_chunks > 1) always runs the interleaved engine.
+    schedule: str = "1f1b"
 
     def __post_init__(self):
         cfg = self.config
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
         if cfg.num_layers % (self.num_stages * self.num_chunks) != 0:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by stages*chunks "
@@ -81,9 +89,12 @@ class PipelinedLlama:
         if cfg.tie_word_embeddings:
             raise NotImplementedError("tied embeddings with PP: use the non-PP model")
         self._layer = LlamaDecoderLayer(cfg)
+        # gradient="matmul": the embedding backward runs INSIDE the pipeline's
+        # partial-manual shard_map (1F1B stage 0), where XLA's partitioner
+        # cannot handle the scatter-add into the vocab-sharded table
         self._embed = ParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, shard_over="vocab",
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, gradient="matmul",
         )
         self._norm = RMSNorm(
             epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -170,17 +181,24 @@ class PipelinedLlama:
         x, _ = lax.scan(body, x, local_layers)
         return x
 
-    def _embed_and_rope(self, params, input_ids):
+    def _rope(self, seq: int):
         cfg = self.config
-        if input_ids.shape[1] > cfg.max_seq_len:
+        if seq > cfg.max_seq_len:
             raise ValueError(
-                f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
-            )
+                f"sequence length {seq} exceeds max_seq_len {cfg.max_seq_len}")
+        return rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
+                                cfg.rope_theta, dtype=cfg.dtype)
+
+    def _embed_and_rope(self, params, input_ids):
         x = self._embed.apply({"params": params["embed"]}, input_ids)
-        seq = input_ids.shape[1]
-        cos, sin = rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
-                                    cfg.rope_theta, dtype=x.dtype)
-        return x, cos, sin
+        cos, sin = self._rope(input_ids.shape[1])
+        return x, cos.astype(x.dtype), sin.astype(x.dtype)
+
+    def _first_fn(self, first_params, ids_t, cos, sin):
+        """Stage-0 embedding (the reference pins the embedding to the first
+        pipeline stage; with the 1F1B engine only int32 ids enter the
+        pipeline, never a full-batch hidden state)."""
+        return self._embed.apply({"params": first_params["embed"]}, ids_t)
 
     @property
     def _engine_remat(self) -> bool:
@@ -227,10 +245,20 @@ class PipelinedLlama:
         boundary (v1 gathered full-batch logits; VERDICT r1 weak #4)."""
         if ignore_index != -100:
             labels = jnp.where(labels == ignore_index, -100, labels)
+        last_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+        labels_mb = microbatch(labels, self.num_microbatches)
+        if self.num_chunks == 1 and self.schedule == "1f1b":
+            cos, sin = self._rope(input_ids.shape[1])
+            run = pipeline_1f1b(
+                self._first_fn, self._stage_fn, self._last_fn,
+                self.num_stages, self.num_microbatches,
+            )
+            ids_mb = microbatch(input_ids, self.num_microbatches)
+            acc = run({"embed": params["embed"]}, params["layers"]["block"],
+                      last_params, ids_mb, labels_mb, (cos, sin))
+            return acc["loss_sum"] / jnp.maximum(acc["count"], 1.0)
         x, cos, sin = self._embed_and_rope(params, input_ids)
         x_mb = microbatch(x, self.num_microbatches)
-        labels_mb = microbatch(labels, self.num_microbatches)
-        last_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
         if self.num_chunks > 1:
             run = pipeline_interleaved(
                 self._stage_fn, self.num_stages, self.num_chunks,

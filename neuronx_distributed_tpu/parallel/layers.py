@@ -138,6 +138,39 @@ class RowParallelLinear(nn.Module):
         return y
 
 
+@jax.custom_vjp
+def embedding_lookup_matmul_bwd(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """``jnp.take(table, ids, axis=0)`` whose BACKWARD is a one-hot einsum
+    instead of a scatter-add.
+
+    Needed inside partial-manual ``shard_map`` regions (the pipeline engines:
+    pp manual, tp GSPMD-auto): XLA's SPMD partitioner CHECK-fails partitioning
+    a scatter into the vocab-sharded table there
+    (``spmd_partitioner_util.cc`` ExpandDeviceGroupsWithIota), while the
+    einsum partitions as an ordinary vocab-contracted matmul. The one-hot is
+    built in the cotangent dtype and XLA fuses it into the reduction; outside
+    shard_map the plain autodiff scatter path remains the default.
+    """
+    return jnp.take(table, ids, axis=0)
+
+
+def _embed_mm_fwd(table, ids):
+    # table rides along only for its static shape/dtype (it is live anyway)
+    return jnp.take(table, ids, axis=0), (ids, table)
+
+
+def _embed_mm_bwd(res, dy):
+    import numpy as np
+
+    ids, table = res
+    onehot = jax.nn.one_hot(ids, table.shape[0], dtype=dy.dtype)
+    dtable = jnp.einsum("...v,...h->vh", onehot, dy)
+    return dtable.astype(table.dtype), np.zeros(ids.shape, jax.dtypes.float0)
+
+
+embedding_lookup_matmul_bwd.defvjp(_embed_mm_fwd, _embed_mm_bwd)
+
+
 class ParallelEmbedding(nn.Module):
     """Embedding table sharded over TP (reference ``ParallelEmbedding``,
     layers.py:101). ``shard_over="vocab"`` partitions rows (reference's
@@ -152,6 +185,10 @@ class ParallelEmbedding(nn.Module):
     dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
     embedding_init: Initializer = default_embed_init
+    # "scatter": plain autodiff (gather fwd / scatter-add bwd).
+    # "matmul": one-hot einsum bwd — required under partial-manual shard_map
+    # (see embedding_lookup_matmul_bwd).
+    gradient: str = "scatter"
 
     def setup(self):
         axes = (TP_AXIS, None) if self.shard_over == "vocab" else (None, TP_AXIS)
@@ -164,7 +201,10 @@ class ParallelEmbedding(nn.Module):
 
     def __call__(self, ids: jax.Array) -> jax.Array:
         (embedding,) = nn.dtypes.promote_dtype(self.embedding, dtype=self.dtype)
-        y = jnp.take(embedding, ids, axis=0)
+        if self.gradient == "matmul":
+            y = embedding_lookup_matmul_bwd(embedding, ids)
+        else:
+            y = jnp.take(embedding, ids, axis=0)
         return constrain(y, ACT_FULL if self.shard_over == "vocab" else ACT_TP)
 
     def attend(self, x: jax.Array) -> jax.Array:
@@ -253,6 +293,25 @@ class GQAQKVColumnParallelLinear(nn.Module):
         q = jnp.einsum("bsh,hnd->bsnd", x, q_kernel)
         k = jnp.einsum("bsh,hnd->bsnd", x, k_kernel)
         v = jnp.einsum("bsh,hnd->bsnd", x, v_kernel)
+        if self.use_bias:
+            # per-head biases, K/V compact like the kernels (reference
+            # qkv_linear.py biases; NeoX/BERT QKV carry biases)
+            q_bias = self.param(
+                "q_bias", nn.with_partitioning(nn.initializers.zeros_init(), (TP_AXIS, None)),
+                (self.num_heads, self.head_dim), self.param_dtype)
+            kv_bias_axes = (TP_AXIS, None) if self.kv_size_multiplier == 1 else (None, None)
+            k_bias = self.param(
+                "k_bias", nn.with_partitioning(nn.initializers.zeros_init(), kv_bias_axes),
+                (self.num_kv_heads, self.head_dim), self.param_dtype)
+            v_bias = self.param(
+                "v_bias", nn.with_partitioning(nn.initializers.zeros_init(), kv_bias_axes),
+                (self.num_kv_heads, self.head_dim), self.param_dtype)
+            if self.kv_size_multiplier > 1:
+                k_bias = jnp.repeat(k_bias, self.kv_size_multiplier, axis=0)
+                v_bias = jnp.repeat(v_bias, self.kv_size_multiplier, axis=0)
+            q = q + q_bias.astype(q.dtype)
+            k = k + k_bias.astype(k.dtype)
+            v = v + v_bias.astype(v.dtype)
         spec = P(DP_AXES, None, TP_AXIS, None)
         return constrain(q, spec), constrain(k, spec), constrain(v, spec)
 

@@ -253,6 +253,226 @@ def pipeline_scalars(
     return apply
 
 
+def _zero_cotangent(x):
+    """Zero cotangent of the right kind: float0 for integer/bool primals
+    (what custom_vjp requires), ordinary zeros for float primals."""
+    import numpy as np
+
+    if jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return jnp.zeros_like(x)
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
+def _scalar_leaf(tree, leaf_name: str):
+    """Pull the ``leaf_name`` leaf out of a scalar pytree (or the tree itself
+    when it is a bare scalar)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    if len(leaves) == 1 and not leaves[0][0]:
+        return leaves[0][1]
+    for path, v in leaves:
+        key = getattr(path[-1], "key", None) or getattr(path[-1], "name", None)
+        if key == leaf_name:
+            return v
+    raise ValueError(f"grad_leaf {leaf_name!r} not found in {jax.tree.structure(tree)}")
+
+
+def pipeline_1f1b(
+    first_fn: Callable[..., jax.Array],
+    stage_fn: Callable[..., jax.Array],
+    last_fn: Callable[..., PyTree],
+    num_stages: int,
+    num_microbatches: int,
+    grad_leaf: str = "loss_sum",
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable[..., PyTree]:
+    """1F1B pipeline with the TRUE 1F1B activation footprint (reference
+    ``Train1F1BSchedule``, scheduler.py:157, executed at model.py:974-1115).
+
+    The GPipe-shaped engines above differentiate a forward-only scan, so XLA
+    must keep one stage-input per tick alive — ``mb + pp − 1`` microbatch
+    activations per rank. This engine instead writes the backward pass BY
+    HAND inside the same scan: each tick runs one forward unit and one
+    backward unit (the backward replays its stage via ``jax.vjp`` — per-unit
+    remat), so live stage inputs are bounded by a fixed circular stash of
+    ``2·pp`` slots regardless of microbatch count:
+
+    * forward of microbatch ``m`` on rank ``r`` at tick ``m + r``; its stage
+      input is stashed in slot ``m mod 2·pp``;
+    * backward of ``m`` on rank ``r`` at tick ``m + 2(pp−1) − r`` — on the
+      last rank the same tick as its forward (loss vjp seeds the cotangent),
+      on earlier ranks exactly when the next rank's ``dx`` arrives on the
+      reverse ``ppermute`` ring. In-flight stage inputs on rank ``r`` peak at
+      ``2(pp−1−r)+1 ≤ 2·pp−1`` — within 2× of 1F1B's ``pp−r`` envelope
+      (slot reuse is safe: slot ``m`` is rewritten at tick ``m+2pp+r``, after
+      its backward at ``m+2(pp−1)−r``);
+    * total ticks ``mb + 2(pp−1)`` — 1F1B's schedule length.
+
+    The first/last stages own their extra work the way the reference pins
+    modules to ranks (embedding on stage 0, head+loss on the last stage):
+    ``first_fn(first_params, ids_t, *broadcast) -> x`` embeds the microbatch
+    ids (so only int32 ids enter the engine — no full-batch hidden-state or
+    its cotangent is ever materialized), ``last_fn`` as in
+    :func:`pipeline_scalars`.
+
+    Exposed as a ``jax.custom_vjp``: the primal computes scalars only (via
+    a forward scan); under differentiation the 1F1B pass computes scalars
+    AND all parameter gradients in ONE combined scan, and bwd just scales
+    them by the ``grad_leaf`` cotangent. Contract: every scalar leaf other
+    than ``grad_leaf`` must be parameter-independent (counts, metrics).
+
+    Returns ``apply(first_params, stacked_params, last_params, ids_mb,
+    aux_mb, broadcast_tuple) -> scalar pytree``.
+    """
+    mesh = mesh or ps.get_mesh()
+    pp_size = mesh.shape[PP_AXIS]
+    if num_stages != pp_size:
+        raise ValueError(
+            f"num_stages ({num_stages}) must equal the mesh's pp axis size ({pp_size})"
+        )
+    S, mb = num_stages, num_microbatches
+    slots = 2 * S
+    ticks = mb + 2 * (S - 1)
+
+    def combined(first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast):
+        """shard_map'd 1F1B pass -> (scalars, gfirst, gstacked_local, glast)."""
+
+        def inner(first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast):
+            rank = lax.axis_index(PP_AXIS)
+            ids0 = jax.tree.map(lambda a: a[0], ids_mb)
+            x_shape = jax.eval_shape(first_fn, first_params, ids0, *broadcast)
+            buf0 = jnp.zeros(x_shape.shape, x_shape.dtype)
+            aux0 = jax.tree.map(lambda a: a[0], aux_mb)
+            out_shape = jax.eval_shape(last_fn, last_params, buf0, aux0, jnp.bool_(True))
+            _scalar_leaf(out_shape, grad_leaf)  # validate the contract early
+            acc0 = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), out_shape)
+            # cotangent seed: 1 on grad_leaf, 0 elsewhere
+            seed = jax.tree_util.tree_map_with_path(
+                lambda path, s: jnp.full(
+                    s.shape, float(
+                        not path  # bare-scalar last_fn: the leaf IS grad_leaf
+                        or (getattr(path[-1], "key", None) or
+                            getattr(path[-1], "name", None)) == grad_leaf),
+                    s.dtype),
+                out_shape)
+            f32zeros = lambda t: jax.tree.map(  # noqa: E731
+                lambda p: jnp.zeros(p.shape, jnp.float32), t)
+            carry0 = (
+                buf0,                                  # fwd ring buffer
+                jnp.zeros_like(buf0),                  # bwd ring buffer (dx)
+                jnp.zeros((slots, *buf0.shape), buf0.dtype),  # stash
+                acc0,
+                f32zeros(first_params), f32zeros(stacked_params),
+                f32zeros(last_params),
+            )
+
+            def tick(carry, t):
+                fwd_buf, bwd_buf, stash, acc, gfirst, gstacked, glast = carry
+                m_f = t - rank
+                m_b = t - 2 * (S - 1) + rank
+                f_idx = jnp.clip(m_f, 0, mb - 1)
+                b_idx = jnp.clip(m_b, 0, mb - 1)
+
+                # ---- forward unit -------------------------------------
+                ids_t = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, f_idx, 0, keepdims=False),
+                    ids_mb)
+                x_first = first_fn(first_params, ids_t, *broadcast)
+                x_in = jnp.where(rank == 0, x_first, fwd_buf)
+                y = stage_fn(stacked_params, x_in, *broadcast)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, x_in, jnp.mod(m_f, slots), axis=0)
+
+                # ---- loss on the draining last stage (m_b == m_f there) --
+                valid_f = (m_f >= 0) & (m_f < mb) & (rank == S - 1)
+                aux_t = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, f_idx, 0, keepdims=False),
+                    aux_mb)
+                out, vjp_last = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, aux_t, valid_f), last_params, y)
+                acc = jax.tree.map(lambda a, o: a + o.astype(jnp.float32), acc, out)
+                dlast, dy_last = vjp_last(seed)
+                glast = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), glast, dlast)
+
+                # ---- backward unit ------------------------------------
+                valid_b = ((m_b >= 0) & (m_b < mb)).astype(buf0.dtype)
+                dy = jnp.where(rank == S - 1, dy_last, bwd_buf) * valid_b
+                x_saved = lax.dynamic_index_in_dim(
+                    stash, jnp.mod(m_b, slots), axis=0, keepdims=False)
+                _, vjp_stage = jax.vjp(
+                    lambda sp, xx: stage_fn(sp, xx, *broadcast),
+                    stacked_params, x_saved)
+                dstacked, dx = vjp_stage(dy)
+                gstacked = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), gstacked, dstacked)
+                # rank 0's stage input came from first_fn: route dx there
+                ids_b = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, b_idx, 0, keepdims=False),
+                    ids_mb)
+                _, vjp_first = jax.vjp(
+                    lambda fp: first_fn(fp, ids_b, *broadcast), first_params)
+                (dfirst,) = vjp_first(dx * (rank == 0).astype(dx.dtype))
+                gfirst = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), gfirst, dfirst)
+
+                # ---- rings --------------------------------------------
+                perm_f = [(i, (i + 1) % S) for i in range(S)]
+                perm_b = [(i, (i - 1) % S) for i in range(S)]
+                return (lax.ppermute(y, PP_AXIS, perm_f),
+                        lax.ppermute(dx, PP_AXIS, perm_b),
+                        stash, acc, gfirst, gstacked, glast), None
+
+            (_, _, _, acc, gfirst, gstacked, glast), _ = lax.scan(
+                tick, carry0, jnp.arange(ticks))
+            psum = lambda t: jax.tree.map(  # noqa: E731
+                lambda a: lax.psum(a, PP_AXIS), t)
+            # gstacked stays per-rank (it IS the pp-sharded grad layout);
+            # first/last params are pp-replicated so their grads psum.
+            return psum(acc), psum(gfirst), gstacked, psum(glast)
+
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), _pp_param_specs(stacked_params), P(), P(), P(), P()),
+            out_specs=(P(), P(), _pp_param_specs(stacked_params), P()),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )(first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast)
+
+    def primal(first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast):
+        # un-differentiated path (eval): plain forward scan, no grads
+        x_mb = jax.vmap(lambda i: first_fn(first_params, i, *broadcast))(ids_mb)
+        run = pipeline_scalars(stage_fn, last_fn, S, mb, remat=False, mesh=mesh)
+        return run(stacked_params, last_params, x_mb, aux_mb, *broadcast)
+
+    wrapped = jax.custom_vjp(primal)
+
+    def fwd(first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast):
+        scalars, gfirst, gstacked, glast = combined(
+            first_params, stacked_params, last_params, ids_mb, aux_mb, broadcast)
+        # grads land in the PARAM dtype (what autodiff would produce);
+        # accumulation already happened in fp32 inside the scan
+        to_param_dtype = lambda g, p: jax.tree.map(  # noqa: E731
+            lambda a, q: a.astype(q.dtype), g, p)
+        return scalars, (to_param_dtype(gfirst, first_params),
+                         to_param_dtype(gstacked, stacked_params),
+                         to_param_dtype(glast, last_params),
+                         ids_mb, aux_mb, broadcast)
+
+    def bwd(res, cot):
+        gfirst, gstacked, glast, ids_mb, aux_mb, broadcast = res
+        scale = _scalar_leaf(cot, grad_leaf).astype(jnp.float32)
+        scaled = lambda g: jax.tree.map(  # noqa: E731
+            lambda a: (a.astype(jnp.float32) * scale).astype(a.dtype), g)
+        return (scaled(gfirst), scaled(gstacked), scaled(glast),
+                jax.tree.map(_zero_cotangent, ids_mb),
+                jax.tree.map(_zero_cotangent, aux_mb),
+                jax.tree.map(_zero_cotangent, broadcast))
+
+    wrapped.defvjp(fwd, bwd)
+    return wrapped
+
+
 def vpp_layer_order(num_layers: int, num_stages: int, num_chunks: int):
     """Permutation mapping canonical layer order to the VPP parameter layout.
 

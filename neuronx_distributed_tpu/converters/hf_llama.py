@@ -115,16 +115,19 @@ def hf_to_nxd_llama(
             },
             "o_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.self_attn.o_proj.weight"))},
         },
-        "mlp": {
-            "gate_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.gate_proj.weight"))},
-            "up_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.up_proj.weight"))},
-            "down_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.down_proj.weight"))},
-        },
         "input_norm": {"scale": stack(lambda i: _np(hf[f"model.layers.{i}.input_layernorm.weight"]))},
         "post_attn_norm": {
             "scale": stack(lambda i: _np(hf[f"model.layers.{i}.post_attention_layernorm.weight"]))
         },
     }
+    # dense MLP keys are absent when the layer's FFN is something else
+    # (Mixtral routes through block_sparse_moe — converters/hf.py adds it)
+    if "model.layers.0.mlp.gate_proj.weight" in hf:
+        block["mlp"] = {
+            "gate_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.gate_proj.weight"))},
+            "up_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.up_proj.weight"))},
+            "down_proj": {"kernel": stack(lambda i: t(f"model.layers.{i}.mlp.down_proj.weight"))},
+        }
     params = {
         "model": {
             "embed": {"embedding": _np(hf["model.embed_tokens.weight"])},
@@ -171,7 +174,7 @@ def nxd_to_hf_llama(
             out[f"model.layers.{i}.self_attn.v_proj.weight"] = v
         out[f"model.layers.{i}.self_attn.o_proj.weight"] = _np(
             blk["attention"]["o_proj"]["kernel"][i], dtype).T
-        for name in ("gate_proj", "up_proj", "down_proj"):
+        for name in ("gate_proj", "up_proj", "down_proj") if "mlp" in blk else ():
             out[f"model.layers.{i}.mlp.{name}.weight"] = _np(blk["mlp"][name]["kernel"][i], dtype).T
         out[f"model.layers.{i}.input_layernorm.weight"] = _np(blk["input_norm"]["scale"][i], dtype)
         out[f"model.layers.{i}.post_attention_layernorm.weight"] = _np(

@@ -13,7 +13,11 @@ Subcommands:
   reference reports the same split per model wrapper);
 * ``speculate`` — draft-assisted decoding (reference
   ``run_llama_speculative.py``): pass --draft_layers to build a shallower
-  draft from the same config, or rely on the tiny self-draft demo.
+  draft from the same config, or rely on the tiny self-draft demo;
+* ``check-accuracy`` — greedy-token match + logit divergence report vs an
+  fp32 cache-free golden (or the fp32 ``transformers`` model with
+  --hf_checkpoint) — reference ``check_accuracy``:290 /
+  ``check_accuracy_logits``:352.
 
 Run (13B dims, TP8):
     python examples/inference/runner.py benchmark --tp 8
@@ -90,26 +94,29 @@ def build_model(args):
     )
     ids = jnp.zeros((1, 8), jnp.int32)
     if args.hf_checkpoint:
-        if args.model != "llama":
+        # family-generic conversion (reference checkpoint_converter.py:20 is
+        # model-generic; dbrx's HF layout differs from mixtral's and is not
+        # mapped yet)
+        if args.model == "dbrx":
             raise SystemExit(
-                "--hf_checkpoint currently supports --model llama only "
-                "(converters/hf_llama.py covers the Llama family)"
+                "--hf_checkpoint supports llama and mixtral layouts; DBRX's "
+                "HF key layout (transformer.blocks.*) has no converter yet"
             )
         import dataclasses
 
         from flax import linen as nn
 
-        from neuronx_distributed_tpu.converters.hf_llama import (
-            config_from_hf,
-            hf_to_nxd_llama,
-            load_hf_safetensors,
-        )
+        from neuronx_distributed_tpu.converters.hf import FAMILIES
+        from neuronx_distributed_tpu.converters.hf_llama import load_hf_safetensors
         from neuronx_distributed_tpu.parallel import mesh as ps
         from neuronx_distributed_tpu.parallel.partitioning import specs_to_shardings
 
+        fam = FAMILIES[args.model]
         cfg = dataclasses.replace(
-            config_from_hf(args.hf_checkpoint), max_seq_len=args.max_seq_len,
+            fam.config_from_hf(args.hf_checkpoint), max_seq_len=args.max_seq_len,
             dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+            # pallas kernels only lower on real TPU (same gate as build_config)
+            use_flash_attention=jax.default_backend() == "tpu",
         )
         if not ps.model_parallel_is_initialized():
             ps.initialize_model_parallel(
@@ -117,10 +124,10 @@ def build_model(args):
             )
         # no throwaway random init: abstract-eval for the sharding specs,
         # then place the converted HF weights directly
-        module = LlamaForCausalLM(cfg)
+        module = _model_cls(args)(cfg)
         abstract = jax.eval_shape(lambda: module.init(jax.random.key(0), ids))
         specs = nn.get_partition_spec(abstract)["params"]
-        params = hf_to_nxd_llama(load_hf_safetensors(args.hf_checkpoint), cfg)
+        params = fam.hf_to_nxd(load_hf_safetensors(args.hf_checkpoint), cfg)
         params = jax.device_put(params, specs_to_shardings(specs, ps.get_mesh()))
     else:
         model = initialize_parallel_model(nxd_config, lambda: _model_cls(args)(cfg), ids)
@@ -270,10 +277,102 @@ def cmd_speculate(args) -> None:
     }))
 
 
+def cmd_check_accuracy(args) -> None:
+    """Correctness gate (reference runner.py ``check_accuracy``:290 +
+    ``check_accuracy_logits``:352): the SERVING stack's greedy continuation
+    and logits are compared against a golden — an fp32 run of the same params
+    through the plain (cache-free) forward, or, with ``--hf_checkpoint``, the
+    fp32 ``transformers`` model itself. Reports the greedy match length,
+    first-divergence position, and teacher-forced logit max-abs-diff; exits
+    nonzero when tokens diverge (the reference asserts the same)."""
+    import dataclasses
+
+    lm, cfg = build_model(args)
+    lm.compile()
+    rs = np.random.RandomState(args.seed)
+    prompt_len = 16 if args.tiny else min(args.prompt_len, 128)
+    prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
+    if lm.max_batch > 1:
+        prompt = np.broadcast_to(prompt, (lm.max_batch, prompt_len)).copy()
+
+    result = lm.generate(prompt, max_new_tokens=args.max_new_tokens,
+                         sampler=Sampler(greedy=True), rng=jax.random.key(0))
+    served = np.asarray(result.tokens[0][: int(result.lengths[0])])
+    full_seq = np.concatenate([prompt[0], served])
+
+    # ---- golden forward (one teacher-forced call reused per decode step) --
+    if args.hf_checkpoint:
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        hf_model = AutoModelForCausalLM.from_pretrained(
+            args.hf_checkpoint, torch_dtype=torch.float32)
+        hf_model.eval()
+
+        def golden_forward(ids_row: np.ndarray) -> np.ndarray:
+            with torch.no_grad():
+                return hf_model(torch.from_numpy(ids_row[None])).logits.numpy()[0]
+
+        golden_name = "transformers_fp32"
+    else:
+        f32_cfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                                      param_dtype=jnp.float32)
+        module = _model_cls(args)(f32_cfg)
+        base = lm.param_transform(lm.params) if lm.param_transform else lm.params
+        params32 = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), base)
+        fwd = jax.jit(lambda ids: module.apply({"params": params32}, ids))
+
+        def golden_forward(ids_row: np.ndarray) -> np.ndarray:
+            return np.asarray(fwd(jnp.asarray(ids_row[None]))[0], np.float32)
+
+        golden_name = "fp32"
+
+    # ---- one teacher-forced golden pass over prompt+served ---------------
+    golden_logits = golden_forward(full_seq)
+
+    # greedy match derived from the SAME pass: the golden's deterministic
+    # continuation equals `served` exactly until the first position k where
+    # argmax(golden_logits[prompt_len-1+k]) != served[k] (while prefixes
+    # agree, teacher-forcing on full_seq IS the golden's autoregression) —
+    # no per-token golden forwards / per-length recompiles needed
+    match_len = 0
+    for k, tok in enumerate(served.tolist()):
+        if int(np.argmax(golden_logits[prompt_len - 1 + k])) != tok:
+            break
+        match_len += 1
+    diverged = match_len < len(served)
+    bucket = lm._bucket_for(len(full_seq))
+    padded = np.zeros((lm.max_batch, bucket), np.int32)
+    padded[:, : len(full_seq)] = full_seq
+    served_logits = np.asarray(
+        lm._prefill[bucket](lm.params, jnp.asarray(padded))[0][0, : len(full_seq)],
+        np.float32)
+    diff = np.abs(served_logits - golden_logits)
+    argmax_mismatch = np.nonzero(
+        served_logits.argmax(-1) != golden_logits.argmax(-1))[0]
+
+    report = {
+        "golden": golden_name,
+        "prompt_len": int(prompt_len),
+        "generated": len(served.tolist()),
+        "greedy_match": not diverged,
+        "match_len": match_len,
+        "first_divergence": match_len if diverged else -1,
+        "logit_max_abs_diff": round(float(diff.max()), 6),
+        "logit_mean_abs_diff": round(float(diff.mean()), 6),
+        "argmax_first_mismatch_pos": (int(argmax_mismatch[0])
+                                      if argmax_mismatch.size else -1),
+        "positions_checked": int(len(full_seq)),
+    }
+    print(json.dumps(report))
+    if diverged:
+        raise SystemExit(1)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("generate", "benchmark", "speculate"):
+    for name in ("generate", "benchmark", "speculate", "check-accuracy"):
         p = sub.add_parser(name)
         p.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
         p.add_argument("--tiny", action="store_true")
@@ -302,7 +401,7 @@ def main(argv=None) -> None:
 
         force_cpu_mesh()
     {"generate": cmd_generate, "benchmark": cmd_benchmark,
-     "speculate": cmd_speculate}[args.cmd](args)
+     "speculate": cmd_speculate, "check-accuracy": cmd_check_accuracy}[args.cmd](args)
 
 
 if __name__ == "__main__":

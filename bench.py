@@ -122,26 +122,58 @@ def step_memory_bytes(step, state, batch_data):
         return None
 
 
-def bench_inference_ttft(prompt_len=2048, depths=(2, 6), trials=15, decode_steps=20):
+def _depth_fit(t: dict, full: int):
+    """Least-squares a + b*L over the measured depths, projected to ``full``.
+    Returns (projection_s, max_abs_residual_s) — residual is None when the
+    fit degenerated (NaN would make the report line invalid JSON). Falls back
+    to conservative naive scaling (fixed cost charged per layer) when noise
+    defeats the fit."""
+    if not t:
+        raise ValueError("_depth_fit needs at least one measured depth")
+    xs = np.asarray(sorted(t), np.float64)
+    ys = np.asarray([t[int(x)] for x in xs])
+    if len(xs) < 2:
+        return ys[-1] / xs[-1] * full, 0.0
+    b, a = np.polyfit(xs, ys, 1)
+    if b <= 0 or a < 0:
+        deepest = int(xs[-1])
+        return t[deepest] / deepest * full, None
+    resid = float(np.max(np.abs(a + b * xs - ys)))
+    return a + full * b, resid
+
+
+def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
+                         decode_steps=20, int8_depths=(1, 6)):
     """Llama-2-13B p50 TTFT + decode throughput (north-star metric #2,
     BASELINE.md; reference benchmark.py:43-71 percentile method).
 
     Same slope method as training: measure prefill/decode at 13B layer dims
-    for two depths, fit a + b*L, project to the full 40 layers. TTFT is
-    end-to-end: prompt in, first sampled token fetched on the host (includes
-    the host<->TPU roundtrip, as a serving stack would pay it).
+    at FOUR depths, least-squares fit a + b*L, project to the full 40 layers
+    (VERDICT r2 weak #1: two depths was the minimum possible fit — no
+    residual, no error bar). The fit runs on two bases and both are
+    reported: per-depth MIN (additive-noise estimator for the shared-tunnel
+    latency spikes, which once flipped the two-point slope) and per-depth
+    p50 (the metric's own definition). The fit residual quantifies how
+    linear the measurements actually were. Decode is additionally measured
+    with int8 weight-only quantized params (the serving path commit 98ad6a3
+    built) at ``int8_depths``. TTFT is end-to-end: prompt in, first sampled
+    token fetched on the host.
     """
     import gc
 
     from neuronx_distributed_tpu.inference import CausalLM
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.quantization.core import (
+        dequantize_params,
+        quantize_params,
+    )
     from neuronx_distributed_tpu.trainer import (
         initialize_parallel_model, neuronx_distributed_config,
     )
 
     FULL = 40  # Llama-2-13B depth
-    prefill_t, decode_t, prefill_p50 = {}, {}, {}
+    prefill_min, prefill_p50, decode_t, decode_int8_t = {}, {}, {}, {}
     for layers in depths:
         if ps.model_parallel_is_initialized():
             ps.destroy_model_parallel()
@@ -171,46 +203,61 @@ def bench_inference_ttft(prompt_len=2048, depths=(2, 6), trials=15, decode_steps
         for _ in range(trials):
             t0 = time.perf_counter()
             logits, cache = lm._prefill[prompt_len](lm.params, prompt)
-            tok = int(jnp.argmax(logits[0, -1]))  # host fetch = sync
+            int(jnp.argmax(logits[0, -1]))  # host fetch = sync
             ts.append(time.perf_counter() - t0)
-        # the depth fit needs the NOISE-FREE compute time: the shared tunnel
-        # adds latency spikes that can exceed the marginal per-layer cost and
-        # flip the slope (observed: L2 prefill "slower" than L6) — min over
-        # trials is the standard additive-noise estimator (same rationale as
-        # timed_steps). Both min (fit basis) and p50 are reported.
-        prefill_t[layers] = float(np.min(ts))
+        prefill_min[layers] = float(np.min(ts))
         prefill_p50[layers] = float(np.percentile(ts, 50))
 
-        # decode: chained steps, fetch-synced window
-        tok = jnp.zeros((1, 1), jnp.int32)
-        logits, cache = lm._decode(lm.params, cache, tok)
-        float(logits[0, 0, 0])
-        t0 = time.perf_counter()
-        for _ in range(decode_steps):
-            logits, cache = lm._decode(lm.params, cache, tok)
-        float(logits[0, 0, 0])
-        decode_t[layers] = (time.perf_counter() - t0) / decode_steps
+        def decode_window(lm_, cache_):
+            tok = jnp.zeros((1, 1), jnp.int32)
+            logits_, cache_ = lm_._decode(lm_.params, cache_, tok)
+            float(logits_[0, 0, 0])
+            t0 = time.perf_counter()
+            for _ in range(decode_steps):
+                logits_, cache_ = lm_._decode(lm_.params, cache_, tok)
+            float(logits_[0, 0, 0])
+            return (time.perf_counter() - t0) / decode_steps
+
+        decode_t[layers] = decode_window(lm, cache)
+
+        if layers in int8_depths:
+            # int8-in-HBM serving: dequant fuses into the compiled programs
+            lm8 = CausalLM(lcfg, quantize_params(model.params), LlamaForCausalLM,
+                           buckets=(prompt_len,), max_batch=1,
+                           param_transform=lambda p: dequantize_params(p, lcfg.dtype))
+            lm8.compile()
+            _, cache8 = lm8._prefill[prompt_len](lm8.params, prompt)
+            decode_int8_t[layers] = decode_window(lm8, cache8)
+            del lm8, cache8
 
         del lm, model, cache, logits
         gc.collect()
 
-    l1, l2 = depths
-    out = {}
-    for name, t in (("ttft", prefill_t), ("decode", decode_t)):
-        b = (t[l2] - t[l1]) / (l2 - l1)
-        a = t[l1] - l1 * b
-        if b <= 0 or a < 0:
-            a, b = 0.0, t[l2] / l2
-        out[name] = a + FULL * b
-    return {
-        # projected from the min-based depth fit (best-case per depth, so the
-        # projection is a lower-bound estimate, labeled accordingly)
-        "ttft_ms_13b_projected_minfit": round(out["ttft"] * 1e3, 1),
-        "decode_ms_per_token_13b_projected": round(out["decode"] * 1e3, 2),
+    ttft_min_proj, ttft_min_resid = _depth_fit(prefill_min, FULL)
+    ttft_p50_proj, ttft_p50_resid = _depth_fit(prefill_p50, FULL)
+    decode_proj, _ = _depth_fit(decode_t, FULL)
+    ms = lambda v: None if v is None else round(v * 1e3, 2)  # noqa: E731
+    report = {
+        "ttft_ms_13b_projected_minfit": ms(ttft_min_proj),
+        "ttft_ms_13b_projected_p50fit": ms(ttft_p50_proj),
+        "ttft_fit_residual_ms": ms(ttft_min_resid),
+        "ttft_p50_fit_residual_ms": ms(ttft_p50_resid),
+        "decode_ms_per_token_13b_projected": ms(decode_proj),
         "ttft_prompt_len": prompt_len,
-        "ttft_min_ms_measured": {str(k): round(v * 1e3, 1) for k, v in prefill_t.items()},
-        "ttft_p50_ms_measured": {str(k): round(v * 1e3, 1) for k, v in prefill_p50.items()},
+        "ttft_fit_depths": list(map(int, sorted(prefill_min))),
+        "ttft_min_ms_measured": {str(k): ms(v) for k, v in sorted(prefill_min.items())},
+        "ttft_p50_ms_measured": {str(k): ms(v) for k, v in sorted(prefill_p50.items())},
+        "decode_ms_measured": {str(k): ms(v) for k, v in sorted(decode_t.items())},
     }
+    if decode_int8_t:  # int8_depths need not intersect depths
+        decode8_proj, _ = _depth_fit(decode_int8_t, FULL)
+        report.update({
+            "decode_ms_per_token_13b_projected_int8": ms(decode8_proj),
+            "decode_tokens_per_sec_13b_int8": round(1.0 / decode8_proj, 1),
+            "decode_int8_ms_measured": {
+                str(k): ms(v) for k, v in sorted(decode_int8_t.items())},
+        })
+    return report
 
 
 def main():

@@ -271,9 +271,30 @@ def _flash_fwd_vjp(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group, num_q
 
 
 def _flash_bwd_vjp(sm_scale, block_q, block_k, group, num_q_heads, res, do):
+    q, k, v, qpos, kpos, out, lse = res
+    # delta pre-pass: rowsum(do * out) — elementwise, let XLA fuse it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+    dq, dk, dv = flash_block_grads(
+        q, k, v, do, lse, delta, qpos, kpos, sm_scale, block_q, block_k,
+        group, num_q_heads,
+    )
+    return dq, dk, dv, None, None
+
+
+def flash_block_grads(q, k, v, do, lse, delta, qpos, kpos, sm_scale,
+                      block_q, block_k, group, num_q_heads):
+    """Run the backward kernels for ONE (q-block, kv-block) pairing under
+    EXTERNALLY-supplied softmax statistics: ``lse``/``delta`` are
+    lane-broadcast ``(b*h, sq, LANES)`` fp32. When they come from this call's
+    own forward this is plain flash backward; when they are GLOBAL statistics
+    over a larger key set (ring attention: LSE/delta of the full-sequence
+    softmax), the returned (dq, dk, dv) are exactly this block's CONTRIBUTION
+    to the global gradients — ``p = exp(s - lse_global)`` is the true global
+    probability restricted to this block, which is all the flash backward
+    recurrence needs. Shapes/layouts as in :func:`_flash_attention_bh`."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, qpos, kpos, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -281,9 +302,6 @@ def _flash_bwd_vjp(sm_scale, block_q, block_k, group, num_q_heads, res, do):
     q_blocks = pl.cdiv(sq, block_q)
     kv_blocks = pl.cdiv(sk, block_k)
     h = num_q_heads
-    # delta pre-pass: rowsum(do * out) — elementwise, let XLA fuse it
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     dkdv_kernel = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, q_blocks=q_blocks, group=group,
@@ -339,10 +357,21 @@ def _flash_bwd_vjp(sm_scale, block_q, block_k, group, num_q_heads, res, do):
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta, qpos, kpos)
-    return dq, dk, dv, None, None
+    return dq, dk, dv
 
 
 _flash_attention_bh.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_block_forward(q, k, v, qpos, kpos, sm_scale, block_q, block_k,
+                        group, num_q_heads):
+    """Forward kernel WITH its softmax statistics: returns ``(out, lse)``
+    where ``lse`` is lane-broadcast ``(b*h, sq, LANES)`` fp32. No VJP — the
+    caller (ring attention) owns the backward by combining
+    :func:`flash_block_grads` calls under the global statistics. Shapes as
+    in :func:`_flash_attention_bh` (flattened, compact GQA K/V)."""
+    return _fwd(q, k, v, qpos, kpos, sm_scale, block_q, block_k, group,
+                num_q_heads)
 
 
 def default_attention_blocks(sq: int) -> tuple:

@@ -103,6 +103,8 @@ class GPTNeoXAttention(nn.Module):
             o = ring_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3), causal=True,
+                layout=cfg.cp_layout,
+                block_q=cfg.attention_block_q, block_k=cfg.attention_block_k,
             )
         else:
             from neuronx_distributed_tpu.kernels.flash_attn import flash_supported

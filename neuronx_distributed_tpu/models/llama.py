@@ -62,6 +62,11 @@ class LlamaConfig:
     # sequence stays sharded THROUGH attention (ops/ring_attention.py) — a
     # TPU-native extension beyond the reference (SURVEY §2.3: no CP there)
     context_parallel: bool = False
+    # "zigzag": balanced CP schedule — the CALLER must feed ids/labels
+    # permuted by ops.ring_attention.zigzag_indices(seq, cp); RoPE positions
+    # and the attention mask follow the true (permuted) positions here.
+    # "contiguous": plain order, last rank carries ~2x the attention work.
+    cp_layout: str = "contiguous"
     use_flash_attention: bool = True
     # None = sequence-adaptive choice (kernels.flash_attn.default_attention_blocks)
     attention_block_q: Optional[int] = None
@@ -236,6 +241,8 @@ class LlamaAttention(nn.Module):
             o = ring_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3), causal=True,
+                layout=cfg.cp_layout,
+                block_q=cfg.attention_block_q, block_k=cfg.attention_block_k,
             )
         else:
             from neuronx_distributed_tpu.kernels.flash_attn import flash_supported
@@ -449,7 +456,17 @@ class LlamaModel(nn.Module):
                 f"sequence length {input_ids.shape[1]} exceeds max_seq_len {cfg.max_seq_len}"
             )
         x = self.embed(input_ids)
-        positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        if cfg.context_parallel and cfg.cp_layout == "zigzag":
+            # tokens arrive zigzag-permuted (caller applied zigzag_indices);
+            # position j of the permuted stream carries TRUE position idx[j]
+            from neuronx_distributed_tpu.ops.ring_attention import zigzag_indices
+            from neuronx_distributed_tpu.parallel import mesh as _ps
+            from neuronx_distributed_tpu.parallel.mesh import CP_AXIS
+
+            positions = zigzag_indices(
+                input_ids.shape[1], _ps.get_mesh().shape[CP_AXIS])
+        else:
+            positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
         # cos/sin computed ONCE here (not per scanned layer) and broadcast
         rope = rotary_embedding(positions, cfg.rope_dims, cfg.rope_theta, dtype=x.dtype)
         if cfg.context_parallel:

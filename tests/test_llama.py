@@ -206,6 +206,45 @@ def test_context_parallel_matches_dense():
     np.testing.assert_allclose(float(loss), float(golden_loss), rtol=1e-5)
 
 
+def test_context_parallel_zigzag_matches_dense():
+    """Zigzag CP: feeding zigzag-permuted (ids, labels) with
+    cp_layout='zigzag' reproduces the dense loss — RoPE positions, the
+    ring's causal mask, and the CE pairing all follow the permutation."""
+    from neuronx_distributed_tpu.ops.ring_attention import zigzag_indices
+
+    ids = _ids((2, 64), 13)
+    labels = _ids((2, 64), 14)
+    cfg_dense = LlamaConfig(**{**TINY, "max_seq_len": 64})
+    model_d = LlamaForCausalLM(cfg_dense)
+    variables = model_d.init(jax.random.PRNGKey(0), ids)
+    from flax.core import meta
+
+    dense = meta.unbox(variables)
+    golden_loss = model_d.apply(dense, ids, labels, method=LlamaForCausalLM.loss)
+    golden_logits = model_d.apply(dense, ids)
+
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=2,
+                                      context_parallel_size=2)
+    idx = zigzag_indices(64, 2)
+    cfg_cp = LlamaConfig(**{**TINY, "max_seq_len": 64, "context_parallel": True,
+                            "cp_layout": "zigzag"})
+    model_cp = LlamaForCausalLM(cfg_cp)
+    from neuronx_distributed_tpu.parallel.partitioning import named_sharding_tree
+
+    sharded = jax.device_put(dense, named_sharding_tree(variables, st.mesh))
+    with jax.set_mesh(st.mesh):
+        loss = jax.jit(
+            lambda p: model_cp.apply(p, ids[:, idx], labels[:, idx],
+                                     method=LlamaForCausalLM.loss)
+        )(sharded)
+        logits = jax.jit(model_cp.apply)(sharded, ids[:, idx])
+    np.testing.assert_allclose(float(loss), float(golden_loss), rtol=1e-5)
+    # un-permuting the output recovers the dense logits
+    inv = np.argsort(np.asarray(idx))
+    np.testing.assert_allclose(np.asarray(logits)[:, inv],
+                               np.asarray(golden_logits), rtol=2e-4, atol=2e-4)
+
+
 def test_context_parallel_train_step():
     cfg = neuronx_distributed_config(
         tensor_parallel_size=2,

@@ -37,7 +37,10 @@ class LoraConfig:
 
     r: int = 8
     lora_alpha: float = 16.0
-    lora_dropout: float = 0.0        # applied by the caller's rng discipline
+    lora_dropout: float = 0.0        # weight-space form, see dropout_adapters
+    # add "embed" to adapt the token embedding (reference LoraEmbedding,
+    # modules/lora/layer.py:245 — in weight space the lookup of W + sAB IS
+    # embedding(x, W) + s*(onehot(x) @ A) @ B, the reference's forward)
     target_modules: Tuple[str, ...] = ("qkv", "o_proj", "gate_proj", "up_proj", "down_proj")
 
     @property
@@ -83,7 +86,11 @@ def init_lora(params: PyTree, config: LoraConfig, rng: jax.Array,
     for (path, leaf), key in zip(flat, keys):
         pstr = jax.tree_util.keystr(path)
         dims = _factor_dims(pstr, getattr(leaf, "shape", ()))
-        if dims is None or not _is_target(pstr, config) or not pstr.endswith("ernel']"):
+        # weight leaves: linear/conv "kernel" and the token "embedding"
+        # (vocab-factorized (V, r) x (r, H), sharding inherited like any
+        # other adapter — reference LoraEmbedding, layer.py:245)
+        is_weight = pstr.endswith("ernel']") or pstr.endswith("mbedding']")
+        if dims is None or not _is_target(pstr, config) or not is_weight:
             continue
         stack, fan_in, fan_out = dims
         a_shape = (stack, fan_in, config.r) if stack else (fan_in, config.r)

@@ -131,6 +131,52 @@ def test_lora_targets_and_specs():
     assert set(specs) == set(model.lora_params)
 
 
+def test_lora_embedding_target():
+    """Reference LoraEmbedding (modules/lora/layer.py:245): targeting
+    "embed" adapts the token embedding — lookup of W + sAB equals
+    embedding(x, W) + s*(onehot(x) @ A) @ B, adapters shard like the
+    vocab-parallel table, and the trainer moves them."""
+    lcfg = LoraConfig(r=4, lora_alpha=8.0,
+                      target_modules=("qkv", "o_proj", "embed"))
+    model, state, step, batch = _build(lora_config=lcfg)
+    embed_keys = [p for p in model.lora_params if "embed" in p]
+    assert len(embed_keys) == 1, list(model.lora_params)
+    (ek,) = embed_keys
+    ad = model.lora_params[ek]
+    vocab, hidden = 128, 32
+    assert ad["lora_a"].shape == (vocab, 4) and ad["lora_b"].shape == (4, hidden)
+
+    # activation-form golden on the embedding leaf
+    rs = np.random.RandomState(7)
+    lora = {ek: {"lora_a": jnp.asarray(ad["lora_a"]),
+                 "lora_b": jnp.asarray(rs.randn(4, hidden) * 0.1, jnp.float32)}}
+    flat = {jax.tree_util.keystr(p): l for p, l in
+            jax.tree_util.tree_flatten_with_path(model.params)[0]}
+    table = flat[ek].astype(jnp.float32)
+    ids = batch["ids"]
+    merged = merge_lora(model.params, lora, lcfg)
+    mflat = {jax.tree_util.keystr(p): l for p, l in
+             jax.tree_util.tree_flatten_with_path(merged)[0]}
+    got = jnp.take(mflat[ek].astype(jnp.float32), ids, axis=0)
+    onehot = jax.nn.one_hot(ids, vocab, dtype=jnp.float32)
+    want = jnp.take(table, ids, axis=0) + lcfg.scaling * (
+        (onehot @ lora[ek]["lora_a"]) @ lora[ek]["lora_b"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # sharding inherited from the vocab-parallel table spec
+    specs = lora_param_specs(model.lora_params, model.params, model.param_specs)
+    assert specs[ek]["lora_a"][0] == "tp" and specs[ek]["lora_b"][1] is None
+
+    # trains: embedding adapter receives nonzero updates
+    before = np.asarray(state.params[ek]["lora_b"])
+    for i in range(3):
+        state, metrics = step(state, batch, jax.random.key(i))
+    after = np.asarray(state.params[ek]["lora_b"])
+    assert not np.allclose(before, after)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_lora_dropout_trains():
     lcfg = LoraConfig(r=4, lora_dropout=0.2)
     model, state, step, batch = _build(lora_config=lcfg)

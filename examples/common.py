@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import argparse
 import time
-from typing import Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -198,10 +198,12 @@ def train_loop(
     metrics_file: Optional[str] = None,
     profile_dir: Optional[str] = None,
     seed: int = 0,
+    extra_metrics: Optional[Dict[str, Any]] = None,
 ):
     """Run ``steps`` training steps with throughput logging, optional
     periodic checkpointing, and optional XProf profiling. Returns
-    ``(final_state, last_metrics_dict)``."""
+    ``(final_state, last_metrics_dict)``. ``extra_metrics``: static
+    key/values (e.g. data-loader stats) attached to every metrics line."""
     start_step = int(state.step)
     throughput = Throughput(batch_size)
     writer = MetricsWriter(metrics_file)
@@ -231,7 +233,8 @@ def train_loop(
                     last_logged = i + 1
                     logger.info("step %d/%d loss %.4f (%.2f seq/s)", i + 1, steps, loss, seq_s)
                     writer.log(i + 1, loss=loss, seqs_per_sec=seq_s,
-                               grad_norm=metrics.get("grad_norm", 0.0))
+                               grad_norm=metrics.get("grad_norm", 0.0),
+                               **(extra_metrics or {}))
                 if checkpoint_dir and checkpoint_every and (i + 1) % checkpoint_every == 0:
                     save_checkpoint(checkpoint_dir, f"step_{i + 1}", state,
                                     user_content={"step": i + 1}, async_save=True,

@@ -375,15 +375,30 @@ def flash_block_forward(q, k, v, qpos, kpos, sm_scale, block_q, block_k,
 
 
 def default_attention_blocks(sq: int) -> tuple:
-    """Sequence-adaptive (block_q, block_k): measured fwd+bwd on a v5-lite
-    chip at 7B head dims (32 heads x 128), larger blocks win as the per-row
-    softmax state amortizes — 2k: (256,512) 48.8ms; 8k: (512,1024) beats
-    (256,512) 1.75x; 16k+: (1024,1024) beats it 1.77x."""
-    if sq <= 4096:
-        return 256, 512
-    if sq <= 8192:
-        return 512, 1024
-    return 1024, 1024
+    """(block_q, block_k) defaults: measured fwd+bwd on a v5-lite chip at 7B
+    head dims (32 heads x 128, bf16). (1024, 1024) wins at EVERY seq that
+    divides it — the r3 re-sweep at b8/s2048 measured fwd+bwd 37.8ms for
+    (1024,1024) vs 62.4ms for the old (256,512) default (1.65x), and 58.6 vs
+    61.8ms at s8192 vs (512,1024); 2048-wide blocks exceed the 16MB VMEM
+    scope at 8k+. Smaller tiers only serve seqs the big blocks don't divide
+    (e.g. 1536), where (512,512) beat (256,512) 56.3 vs 62.4ms at 2k."""
+    for b in (1024, 512, 256, 128):
+        if flash_supported(sq, sq, b, b):
+            return min(b, sq), min(b, sq)
+    return min(128, sq), min(128, sq)
+
+
+def default_prefill_blocks(sq: int) -> tuple:
+    """(block_q, block_k) for FORWARD-ONLY use (inference prefill): the fwd
+    kernel alone prefers smaller q blocks — measured 34.7ms (256,512) vs
+    39.1ms (1024,1024) at b8/s2048/32h/128d — while training's combined
+    fwd+bwd strongly prefers (1024,1024) (see default_attention_blocks).
+    Sides are chosen independently: ``blocks_for`` consumes ``pick(sq)[0]``
+    and ``pick(sk)[1]`` separately."""
+    fallback = default_attention_blocks(sq)
+    bq = 256 if flash_supported(sq, sq, 256, 256) else fallback[0]
+    bk = 512 if flash_supported(sq, sq, 512, 512) else fallback[1]
+    return bq, bk
 
 
 def flash_supported(sq: int, sk: int, block_q: int, block_k: int) -> bool:

@@ -112,11 +112,16 @@ class LlamaConfig:
         divisibility constraint holds for lengths like 1280 or 4608; when no
         >=128 divisor exists the caller's ``flash_supported`` guard routes
         to the dense path."""
-        from neuronx_distributed_tpu.kernels.flash_attn import default_attention_blocks
+        from neuronx_distributed_tpu.kernels.flash_attn import (
+            default_attention_blocks,
+            default_prefill_blocks,
+        )
 
+        # decode mode never differentiates: prefill uses the fwd-tuned blocks
+        pick = default_prefill_blocks if self.decode else default_attention_blocks
         sk = sk or sq
-        dq = self.attention_block_q or default_attention_blocks(sq)[0]
-        dk = self.attention_block_k or default_attention_blocks(sk)[1]
+        dq = self.attention_block_q or pick(sq)[0]
+        dk = self.attention_block_k or pick(sk)[1]
 
         def shrink(b: int, s: int) -> int:
             b = min(b, s)

@@ -164,10 +164,7 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
     from neuronx_distributed_tpu.inference import CausalLM
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from neuronx_distributed_tpu.parallel import mesh as ps
-    from neuronx_distributed_tpu.quantization.core import (
-        dequantize_params,
-        quantize_params,
-    )
+    from neuronx_distributed_tpu.quantization.core import quantize_params
     from neuronx_distributed_tpu.trainer import (
         initialize_parallel_model, neuronx_distributed_config,
     )
@@ -221,10 +218,10 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
         decode_t[layers] = decode_window(lm, cache)
 
         if layers in int8_depths:
-            # int8-in-HBM serving: dequant fuses into the compiled programs
+            # int8-in-HBM serving: quantized leaves feed the model directly;
+            # the layers dequantize in-scan (quantization/core.dequantize_leaf)
             lm8 = CausalLM(lcfg, quantize_params(model.params), LlamaForCausalLM,
-                           buckets=(prompt_len,), max_batch=1,
-                           param_transform=lambda p: dequantize_params(p, lcfg.dtype))
+                           buckets=(prompt_len,), max_batch=1)
             lm8.compile()
             _, cache8 = lm8._prefill[prompt_len](lm8.params, prompt)
             decode_int8_t[layers] = decode_window(lm8, cache8)

@@ -68,13 +68,17 @@ class ExpertMLPs(nn.Module):
 
     def _mlp(self, h: jax.Array) -> jax.Array:
         """h: (E, C, H) expert-major activations, E sharded over ep."""
+        from neuronx_distributed_tpu.quantization.core import dequantize_leaf
+
         h = h.astype(self.dtype)
-        wg = self.w_gate.astype(self.dtype)
-        wd = self.w_down.astype(self.dtype)
+        # int8 serving: quantized leaves dequantize per-expert-tensor here
+        wg = dequantize_leaf(self.w_gate, self.dtype).astype(self.dtype)
+        wd = dequantize_leaf(self.w_down, self.dtype).astype(self.dtype)
         g = jnp.einsum("ech,ehi->eci", h, wg)
         g = constrain(g, P(EP_AXIS, None, TP_AXIS))
         if self.glu:
-            u = jnp.einsum("ech,ehi->eci", h, self.w_up.astype(self.dtype))
+            u = jnp.einsum("ech,ehi->eci", h,
+                           dequantize_leaf(self.w_up, self.dtype).astype(self.dtype))
             a = nn.silu(g) * u
         else:
             a = nn.gelu(g)
@@ -131,11 +135,24 @@ class ExpertMLPs(nn.Module):
         in_dtype = x.dtype
         aff, idx = jax.lax.top_k(combine, top_k)                   # (T, k)
         x = x.astype(self.dtype)
-        wg = jnp.take(self.w_gate, idx, axis=0).astype(self.dtype)  # (T, k, H, I)
-        wd = jnp.take(self.w_down, idx, axis=0).astype(self.dtype)  # (T, k, I, H)
+
+        def take_expert(w):
+            # int8 serving: gather the INT8 rows (half the HBM gather
+            # traffic), dequantize only the gathered (T, k, ...) slice
+            from collections.abc import Mapping
+
+            if isinstance(w, Mapping) and "qweight" in w:
+                qw = jnp.take(w["qweight"], idx, axis=0)
+                sc = w["scale"]  # per-tensor scale is 0-d: no expert axis
+                sc = jnp.take(sc, idx, axis=0) if sc.ndim else sc
+                return (qw.astype(jnp.float32) * sc).astype(self.dtype)
+            return jnp.take(w, idx, axis=0).astype(self.dtype)
+
+        wg = take_expert(self.w_gate)                              # (T, k, H, I)
+        wd = take_expert(self.w_down)                              # (T, k, I, H)
         g = jnp.einsum("th,tkhi->tki", x, wg)
         if self.glu:
-            wu = jnp.take(self.w_up, idx, axis=0).astype(self.dtype)
+            wu = take_expert(self.w_up)
             a = nn.silu(g) * jnp.einsum("th,tkhi->tki", x, wu)
         else:
             a = nn.gelu(g)

@@ -36,6 +36,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.parallel.mesh import DP_AXES, TP_AXIS
+from neuronx_distributed_tpu.quantization.core import dequantize_leaf
 from neuronx_distributed_tpu.parallel.partitioning import (
     ACT_FULL,
     ACT_SP,
@@ -91,6 +92,10 @@ class ColumnParallelLinear(nn.Module):
             )
         if self.sequence_parallel:
             x = constrain(x, ACT_SP)
+        # int8 serving: a {'qweight','scale'} leaf dequantizes HERE — inside
+        # the layer (= inside the scan body for stacked models), so the int8
+        # weights are what HBM holds and the convert fuses into the matmul
+        kernel = dequantize_leaf(kernel, self.dtype or self.param_dtype)
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = x @ kernel
         if bias is not None:
@@ -130,6 +135,7 @@ class RowParallelLinear(nn.Module):
             bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
         if self.input_is_parallel:
             x = constrain(x, ACT_TP)
+        kernel = dequantize_leaf(kernel, self.dtype or self.param_dtype)
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = x @ kernel
         y = constrain(y, ACT_SP if self.sequence_parallel else ACT_FULL)
@@ -284,6 +290,8 @@ class GQAQKVColumnParallelLinear(nn.Module):
         )
         if self.sequence_parallel:
             x = constrain(x, ACT_SP)
+        dq = lambda k: dequantize_leaf(k, self.dtype or self.param_dtype)  # noqa: E731
+        q_kernel, k_kernel, v_kernel = dq(q_kernel), dq(k_kernel), dq(v_kernel)
         x, q_kernel, k_kernel, v_kernel = nn.dtypes.promote_dtype(
             x, q_kernel, k_kernel, v_kernel, dtype=self.dtype
         )

@@ -172,6 +172,81 @@ def test_int8_generate_close_to_fp(tmp_path):
     assert (out.tokens[0] >= 0).all() and (out.tokens[0] < 128).all()
 
 
+def test_int8_direct_in_layer_dequant():
+    """The fast serving path: the quantized tree feeds the model with NO
+    param_transform — the parallel layers dequantize {'qweight','scale'}
+    leaves in-layer (inside the scan body for stacked kernels), so the int8
+    stack never materializes as bf16 up front. Must match the
+    param_transform path bit-for-bit (same dequant math, same dtype)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference import CausalLM
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.quantization.core import (
+        dequantize_params,
+        quantize_params,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=64,
+                      dtype=jnp.float32, use_flash_attention=False,
+                      remat_policy=None)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, 127),
+                     np.int32)
+    model = LlamaForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(ids)))["params"]
+    qparams = quantize_params(params)
+
+    # training-style forward: quantized tree straight through module.apply
+    direct = model.apply({"params": qparams}, jnp.asarray(ids))
+    via_transform = model.apply(
+        {"params": dequantize_params(qparams, cfg.dtype)}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(direct), np.asarray(via_transform),
+                               rtol=1e-6, atol=1e-6)
+
+    # serving: no param_transform
+    lm_direct = CausalLM(cfg, qparams, LlamaForCausalLM, buckets=(8,), max_batch=1)
+    out_d = lm_direct.generate(ids, max_new_tokens=6)
+    lm_t = CausalLM(cfg, qparams, LlamaForCausalLM, buckets=(8,), max_batch=1,
+                    param_transform=lambda p: dequantize_params(p, cfg.dtype))
+    out_t = lm_t.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out_d.tokens), np.asarray(out_t.tokens))
+
+
+def test_int8_moe_expert_quantization():
+    """MoE int8 serving: the fused expert tensors (leaves gate/up/down)
+    quantize by default, the router stays float (routing is the most
+    quantization-sensitive op), and both selective-loading and all-experts
+    decode paths consume the quantized tree directly."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    from neuronx_distributed_tpu.quantization.core import quantize_params
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=4, max_seq_len=32, dtype=jnp.float32,
+        use_flash_attention=False, num_experts=4, top_k=2, remat_policy=None)
+    ids = jnp.asarray(np.random.RandomState(0).randint(1, 127, (1, 8)))
+    model = MixtralForCausalLM(cfg)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), ids))["params"]
+    qp = quantize_params(params)
+    flat = {jax.tree_util.keystr(p): l for p, l in
+            jax.tree_util.tree_flatten_with_path(
+                qp, is_leaf=lambda x: isinstance(x, dict) and "qweight" in x)[0]}
+    expert_q = [k for k, v in flat.items()
+                if isinstance(v, dict) and ("gate" in k or "down" in k)]
+    router_q = [k for k, v in flat.items()
+                if isinstance(v, dict) and "router" in k]
+    assert expert_q, "expert tensors not quantized"
+    assert not router_q, "router must stay float"
+    out = model.apply({"params": qp}, ids)
+    golden = model.apply({"params": params}, ids)
+    # int8 experts track the float forward closely on tiny dims
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.argmax(np.asarray(out)[0, -1]) == np.argmax(np.asarray(golden)[0, -1])
+
+
 def test_int8_session_api():
     """start_session/insert/step through the param_transform hook (r2 review:
     the session path bypassed the transform)."""

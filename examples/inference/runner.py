@@ -95,13 +95,7 @@ def build_model(args):
     ids = jnp.zeros((1, 8), jnp.int32)
     if args.hf_checkpoint:
         # family-generic conversion (reference checkpoint_converter.py:20 is
-        # model-generic; dbrx's HF layout differs from mixtral's and is not
-        # mapped yet)
-        if args.model == "dbrx":
-            raise SystemExit(
-                "--hf_checkpoint supports llama and mixtral layouts; DBRX's "
-                "HF key layout (transformer.blocks.*) has no converter yet"
-            )
+        # model-generic): llama, mixtral, and dbrx layouts
         import dataclasses
 
         from flax import linen as nn

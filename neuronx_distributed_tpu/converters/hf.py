@@ -390,6 +390,126 @@ def nxd_to_hf_bert(params: PyTree, config, dtype: Any = np.float32) -> Dict[str,
     return out
 
 
+# -------------------------------------------------------------------- dbrx
+
+def dbrx_config_from_hf(path: str):
+    """HF DbrxConfig nests attention/ffn settings under ``attn_config`` /
+    ``ffn_config``; architecture = the MoE stack with bias-free LayerNorms
+    and clipped QKV (models/mixtral.py dbrx preset)."""
+    from neuronx_distributed_tpu.models.mixtral import MixtralConfig
+
+    hc = _read_hf_config(path)
+    attn = hc.get("attn_config", {}) or {}
+    ffn = hc.get("ffn_config", {}) or {}
+    return MixtralConfig(
+        vocab_size=hc["vocab_size"], hidden_size=hc["d_model"],
+        intermediate_size=ffn.get("ffn_hidden_size", 10752),
+        num_layers=hc["n_layers"], num_heads=hc["n_heads"],
+        num_kv_heads=attn.get("kv_n_heads", 8),
+        rope_theta=attn.get("rope_theta", 5e5),
+        num_experts=ffn.get("moe_num_experts", 16),
+        top_k=ffn.get("moe_top_k", 4),
+        max_seq_len=hc.get("max_seq_len", 2048),
+        tie_word_embeddings=hc.get("tie_word_embeddings", False),
+        norm_type="layernorm", norm_bias=False,
+        qkv_clip=attn.get("clip_qkv"),
+    )
+
+
+def hf_to_nxd_dbrx(hf: Dict[str, np.ndarray], config,
+                   dtype: Optional[Any] = None) -> PyTree:
+    """DBRX HF layout (``transformer.blocks.*``): fused ``Wqkv`` in [Q;K;V]
+    block order; experts PRE-FUSED as ``mlp.w1/v1/w2`` of shape (E*I, H) —
+    HF's ``DbrxExpertGLU`` computes ``x @ w1[e].T`` (gate), ``x @ v1[e].T``
+    (up), ``a @ w2[e]`` (down), so gate/up transpose to (E, H, I) and down
+    stays (E, I, H); bias-free LayerNorms land under the ``ln`` submodule."""
+    cfg = config
+    L, E, H, I = cfg.num_layers, cfg.num_experts, cfg.hidden_size, cfg.intermediate_size
+    N, NKV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    dt = dtype or cfg.param_dtype
+
+    def blk(i: int) -> str:
+        return f"transformer.blocks.{i}"
+
+    def qkv(i):
+        w = _np(hf[f"{blk(i)}.norm_attn_norm.attn.Wqkv.weight"])  # (ND+2NkvD, H)
+        q, k, v = np.split(w, [N * D, N * D + NKV * D], axis=0)
+        return (q.T.reshape(H, N, D), k.T.reshape(H, NKV, D), v.T.reshape(H, NKV, D))
+
+    qs, ks, vs = zip(*(qkv(i) for i in range(L)))
+    stack = lambda f: np.stack([f(i) for i in range(L)])  # noqa: E731
+    block = {
+        "attention": {
+            "qkv": {"q_kernel": np.stack(qs), "k_kernel": np.stack(ks),
+                    "v_kernel": np.stack(vs)},
+            "o_proj": {"kernel": stack(
+                lambda i: _np(hf[f"{blk(i)}.norm_attn_norm.attn.out_proj.weight"]).T)},
+        },
+        "input_norm": {"ln": {"scale": stack(
+            lambda i: _np(hf[f"{blk(i)}.norm_attn_norm.norm_1.weight"]))}},
+        "post_attn_norm": {"ln": {"scale": stack(
+            lambda i: _np(hf[f"{blk(i)}.norm_attn_norm.norm_2.weight"]))}},
+        "moe": {
+            "router": {"kernel": stack(
+                lambda i: _np(hf[f"{blk(i)}.ffn.router.layer.weight"]).T)},
+            "experts": {
+                "gate": stack(lambda i: _np(
+                    hf[f"{blk(i)}.ffn.experts.mlp.w1"]).reshape(E, I, H).transpose(0, 2, 1)),
+                "up": stack(lambda i: _np(
+                    hf[f"{blk(i)}.ffn.experts.mlp.v1"]).reshape(E, I, H).transpose(0, 2, 1)),
+                "down": stack(lambda i: _np(
+                    hf[f"{blk(i)}.ffn.experts.mlp.w2"]).reshape(E, I, H)),
+            },
+        },
+    }
+    params = {
+        "model": {
+            "embed": {"embedding": _np(hf["transformer.wte.weight"])},
+            "layers": {"block": block},
+            "final_norm": {"ln": {"scale": _np(hf["transformer.norm_f.weight"])}},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _np(hf["lm_head.weight"]).T}
+    return _to_jnp(params, dt)
+
+
+def nxd_to_hf_dbrx(params: PyTree, config, dtype: Any = np.float32) -> Dict[str, np.ndarray]:
+    cfg = config
+    L, E = cfg.num_layers, cfg.num_experts
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    N, NKV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    blk = params["model"]["layers"]["block"]
+    out = {
+        "transformer.wte.weight": _np(params["model"]["embed"]["embedding"], dtype),
+        "transformer.norm_f.weight": _np(
+            params["model"]["final_norm"]["ln"]["scale"], dtype),
+    }
+    if "lm_head" in params:
+        out["lm_head.weight"] = _np(params["lm_head"]["kernel"], dtype).T
+    for i in range(L):
+        q = _np(blk["attention"]["qkv"]["q_kernel"][i], dtype).reshape(H, N * D).T
+        k = _np(blk["attention"]["qkv"]["k_kernel"][i], dtype).reshape(H, NKV * D).T
+        v = _np(blk["attention"]["qkv"]["v_kernel"][i], dtype).reshape(H, NKV * D).T
+        b = f"transformer.blocks.{i}"
+        out[f"{b}.norm_attn_norm.attn.Wqkv.weight"] = np.concatenate([q, k, v], axis=0)
+        out[f"{b}.norm_attn_norm.attn.out_proj.weight"] = _np(
+            blk["attention"]["o_proj"]["kernel"][i], dtype).T
+        out[f"{b}.norm_attn_norm.norm_1.weight"] = _np(
+            blk["input_norm"]["ln"]["scale"][i], dtype)
+        out[f"{b}.norm_attn_norm.norm_2.weight"] = _np(
+            blk["post_attn_norm"]["ln"]["scale"][i], dtype)
+        out[f"{b}.ffn.router.layer.weight"] = _np(
+            blk["moe"]["router"]["kernel"][i], dtype).T
+        out[f"{b}.ffn.experts.mlp.w1"] = _np(
+            blk["moe"]["experts"]["gate"][i], dtype).transpose(0, 2, 1).reshape(E * I, H)
+        out[f"{b}.ffn.experts.mlp.v1"] = _np(
+            blk["moe"]["experts"]["up"][i], dtype).transpose(0, 2, 1).reshape(E * I, H)
+        out[f"{b}.ffn.experts.mlp.w2"] = _np(
+            blk["moe"]["experts"]["down"][i], dtype).reshape(E * I, H)
+    return out
+
+
 # -------------------------------------------------------------------- registry
 
 class Family(NamedTuple):
@@ -403,6 +523,7 @@ FAMILIES: Dict[str, Family] = {
     "mixtral": Family(mixtral_config_from_hf, hf_to_nxd_mixtral, nxd_to_hf_mixtral),
     "gpt_neox": Family(neox_config_from_hf, hf_to_nxd_neox, nxd_to_hf_neox),
     "bert": Family(bert_config_from_hf, hf_to_nxd_bert, nxd_to_hf_bert),
+    "dbrx": Family(dbrx_config_from_hf, hf_to_nxd_dbrx, nxd_to_hf_dbrx),
 }
 
 
@@ -412,6 +533,8 @@ def detect_family(hf_keys) -> str:
     keys = list(hf_keys)
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
+    if any("norm_attn_norm" in k for k in keys):  # DBRX-unique submodule
+        return "dbrx"
     if any(k.startswith("gpt_neox.") for k in keys):
         return "gpt_neox"
     if any(k.startswith("bert.") for k in keys):

@@ -74,6 +74,8 @@ class LlamaConfig:
     remat_policy: Optional[str] = "full"  # None | "full" | "attention"
     kv_size_multiplier: int = 1
     tie_word_embeddings: bool = False
+    # clamp q/k/v projections to [-qkv_clip, qkv_clip] (DBRX's clip_qkv)
+    qkv_clip: Optional[float] = None
     decode: bool = False  # KV-cache inference mode (cache collection)
     # CE loss sequence-chunking (long-seq memory lever): the head matmul +
     # CE run per chunk of this many tokens when seq exceeds it (None = 4096)
@@ -89,20 +91,26 @@ class LlamaConfig:
         overrides this to ``rotary_pct * head_dim``)."""
         return self.head_dim_
 
-    def make_final_norm(self, name: Optional[str] = None):
-        """The stack's final norm (GPT-NeoX overrides via ``norm_type``)."""
+    def make_norm(self, name: Optional[str] = None):
+        """Norm factory honoring ``norm_type``/``norm_bias`` (rmsnorm default;
+        GPT-NeoX and DBRX select layernorm) — builds the stack's final norm
+        AND every decoder-layer norm, so the selection applies uniformly."""
         if getattr(self, "norm_type", "rmsnorm") == "layernorm":
             from neuronx_distributed_tpu.parallel.layers import SPLayerNorm
 
             return SPLayerNorm(
                 epsilon=getattr(self, "layer_norm_eps", 1e-5), dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                use_bias=getattr(self, "norm_bias", True),  # DBRX: bias-free
                 sequence_parallel=self.sequence_parallel, name=name,
             )
         return RMSNorm(
             epsilon=self.rms_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
             sequence_parallel=self.sequence_parallel, name=name,
         )
+
+    # back-compat name (pre-r3 external callers)
+    make_final_norm = make_norm
 
     def blocks_for(self, sq: int, sk: Optional[int] = None) -> Tuple[int, int]:
         """Flash block sizes: explicit config values, else adaptive — block_q
@@ -234,6 +242,10 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
+        if cfg.qkv_clip is not None:  # DBRX clip_qkv (applied pre-RoPE)
+            q = jnp.clip(q, -cfg.qkv_clip, cfg.qkv_clip)
+            k = jnp.clip(k, -cfg.qkv_clip, cfg.qkv_clip)
+            v = jnp.clip(v, -cfg.qkv_clip, cfg.qkv_clip)
         if cfg.decode:
             return self._decode_attention(x, q, k, v, chunk_ctx)
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
@@ -388,11 +400,9 @@ class LlamaDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, rope, chunk_ctx=None) -> jax.Array:
         cfg = self.config
-        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
+        h = cfg.make_norm(name="input_norm")(x)
         x = x + LlamaAttention(cfg, name="attention")(h, rope, chunk_ctx)
-        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
+        h = cfg.make_norm(name="post_attn_norm")(x)
         return x + LlamaMLP(cfg, name="mlp")(h)
 
 
@@ -452,7 +462,7 @@ class LlamaModel(nn.Module):
             in_axes=nn.broadcast,
             metadata_params={nn.meta.PARTITION_NAME: None},
         )(cfg, self.layer_cls)
-        self.final_norm = cfg.make_final_norm()
+        self.final_norm = cfg.make_norm()
 
     def __call__(self, input_ids: jax.Array, chunk_ctx=None) -> jax.Array:
         cfg = self.config

@@ -39,6 +39,11 @@ class MixtralConfig(LlamaConfig):
     aux_loss_coef: float = 0.01
     z_loss_coef: float = 0.0
     selective_loading_threshold: float = 0.5
+    # DBRX serves through this stack with bias-free LayerNorms instead of
+    # RMSNorm (HF DbrxBlock norm_1/norm_2/norm_f are nn.LayerNorm(bias=False))
+    norm_type: str = "rmsnorm"  # | "layernorm"
+    norm_bias: bool = True
+    layer_norm_eps: float = 1e-5
 
 
 def mixtral_8x7b(**over) -> MixtralConfig:
@@ -56,6 +61,8 @@ def dbrx(**over) -> MixtralConfig:
         vocab_size=100352, hidden_size=6144, intermediate_size=10752,
         num_layers=40, num_heads=48, num_kv_heads=8, rope_theta=5e5,
         num_experts=16, top_k=4,
+        # DBRX-specific architecture bits (HF DbrxConfig defaults)
+        norm_type="layernorm", norm_bias=False, qkv_clip=8.0,
     ), **over})
 
 
@@ -65,11 +72,9 @@ class MixtralDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, rope) -> jax.Array:
         cfg = self.config
-        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    sequence_parallel=cfg.sequence_parallel, name="input_norm")(x)
+        h = cfg.make_norm(name="input_norm")(x)
         x = x + LlamaAttention(cfg, name="attention")(h, rope)
-        h = RMSNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    sequence_parallel=cfg.sequence_parallel, name="post_attn_norm")(x)
+        h = cfg.make_norm(name="post_attn_norm")(x)
         moe_out = MoE(
             num_experts=cfg.num_experts,
             hidden_size=cfg.hidden_size,

@@ -335,13 +335,15 @@ class SPLayerNorm(nn.Module):
     dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
     sequence_parallel: bool = False
+    use_bias: bool = True  # DBRX norms are bias-free LayerNorms
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         if self.sequence_parallel:
             x = constrain(x, ACT_SP)
         return nn.LayerNorm(
-            epsilon=self.epsilon, dtype=self.dtype, param_dtype=self.param_dtype, name="ln"
+            epsilon=self.epsilon, dtype=self.dtype, param_dtype=self.param_dtype,
+            use_bias=self.use_bias, name="ln",
         )(x)
 
 

@@ -319,3 +319,68 @@ def test_detect_family(hf_mixtral, hf_neox, hf_bert, hf_model):
     assert detect_family(_state(hf_neox)) == "gpt_neox"
     assert detect_family(_state(hf_bert)) == "bert"
     assert detect_family(_state(hf_model)) == "llama"
+
+
+# ------------------------------------------------------------------- dbrx
+
+@pytest.fixture(scope="module")
+def hf_dbrx():
+    import torch
+    from transformers import DbrxConfig as HFC, DbrxForCausalLM as HFM
+
+    torch.manual_seed(0)
+    m = HFM(HFC(
+        d_model=32, n_heads=4, n_layers=2, max_seq_len=64, vocab_size=96,
+        attn_config=dict(kv_n_heads=2, clip_qkv=8.0, rope_theta=10000.0),
+        ffn_config=dict(ffn_hidden_size=48, moe_num_experts=4, moe_top_k=2),
+        attn_pdrop=0.0, resid_pdrop=0.0,
+    ))
+    m.eval()
+    return m
+
+
+def _dbrx_cfg():
+    from neuronx_distributed_tpu.models.mixtral import MixtralConfig
+
+    return MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=48, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=64, num_experts=4, top_k=2,
+        moe_mode="all_experts", use_flash_attention=False, remat_policy=None,
+        norm_type="layernorm", norm_bias=False, qkv_clip=8.0,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_dbrx_logit_parity(hf_dbrx):
+    """VERDICT r2: dbrx HF layout (transformer.blocks.*, pre-fused experts,
+    [Q;K;V] Wqkv, bias-free LayerNorms, clip_qkv) — converted weights must
+    reproduce transformers' logits."""
+    import torch
+
+    from neuronx_distributed_tpu.converters.hf import hf_to_nxd_dbrx
+    from neuronx_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+    cfg = _dbrx_cfg()
+    params = hf_to_nxd_dbrx(_state(hf_dbrx), cfg)
+    ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+    with torch.no_grad():
+        want = hf_dbrx(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(
+        MixtralForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_dbrx_roundtrip_exact(hf_dbrx):
+    from neuronx_distributed_tpu.converters.hf import (
+        detect_family,
+        hf_to_nxd_dbrx,
+        nxd_to_hf_dbrx,
+    )
+
+    cfg = _dbrx_cfg()
+    hf_state = _state(hf_dbrx)
+    assert detect_family(hf_state) == "dbrx"
+    back = nxd_to_hf_dbrx(hf_to_nxd_dbrx(hf_state, cfg), cfg)
+    for k, v in hf_state.items():
+        if "rotary_emb" in k:
+            continue
+        np.testing.assert_array_equal(back[k], v, err_msg=k)

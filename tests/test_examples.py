@@ -201,6 +201,39 @@ def test_inference_runner_mixtral_hf_checkpoint(tmp_path, capsys):
     assert len(toks) == 4 and all(0 <= t < 96 for t in toks)
 
 
+def test_inference_runner_dbrx_hf_checkpoint(tmp_path, capsys):
+    """--hf_checkpoint for dbrx: a tiny HF Dbrx checkpoint (transformer.blocks
+    layout, pre-fused experts, clip_qkv, bias-free LayerNorms) converts and
+    serves end-to-end."""
+    import json as _json
+
+    import torch
+    from transformers import DbrxConfig as HFC, DbrxForCausalLM as HFM
+
+    from neuronx_distributed_tpu.converters.hf_llama import save_hf_safetensors
+
+    torch.manual_seed(0)
+    hc = HFC(d_model=32, n_heads=4, n_layers=2, max_seq_len=64, vocab_size=96,
+             attn_config=dict(kv_n_heads=2, clip_qkv=8.0, rope_theta=10000.0),
+             ffn_config=dict(ffn_hidden_size=48, moe_num_experts=4, moe_top_k=2))
+    m = HFM(hc)
+    state = {k: v.detach().numpy() for k, v in m.state_dict().items()
+             if "rotary_emb" not in k}
+    hf_dir = tmp_path / "hf_dbrx"
+    hf_dir.mkdir()
+    save_hf_safetensors(state, str(hf_dir / "model.safetensors"))
+    (hf_dir / "config.json").write_text(_json.dumps(hc.to_dict()))
+
+    import runner
+
+    runner.main(["generate", "--model", "dbrx", "--tiny",
+                 "--hf_checkpoint", str(hf_dir), "--max_seq_len", "64",
+                 "--max_new_tokens", "4"])
+    lines = [_json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    toks = lines[0]["generated"]
+    assert len(toks) == 4 and all(0 <= t < 96 for t in toks)
+
+
 def test_inference_runner_check_accuracy_tiny(capsys):
     """VERDICT r2 missing #4: serving stack vs cache-free fp32 golden —
     greedy tokens must match exactly on the tiny (fp32) config and logits

@@ -298,6 +298,15 @@ def main():
         infer = bench_inference_ttft()
     except Exception as e:  # keep the primary metric printable regardless
         infer = {"ttft_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        # fused ring-attention CP vs SP+flash at equal global tokens
+        # (single-chip-scaled; utils/cp_microbench.py)
+        from neuronx_distributed_tpu.utils.cp_microbench import measure_cp_ratio
+
+        cp_row = measure_cp_ratio(16384, trials=3)
+        infer["cp2_zigzag_vs_sp_flash_throughput_16k"] = cp_row["cp_vs_sp_throughput"]
+    except Exception as e:
+        infer["cp_bench_error"] = f"{type(e).__name__}: {e}"[:120]
     print(json.dumps({
         "metric": "llama2_7b_train_tokens_per_sec_per_chip",
         "value": round(tok_s_7b, 1),

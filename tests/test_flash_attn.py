@@ -190,3 +190,39 @@ def test_masked_gradients():
             err_msg=f"masked grad mismatch for {name}",
         )
     assert np.all(np.asarray(g_flash[1])[:, :, L:, :] == 0.0), "pad-key grads must be zero"
+
+
+def test_default_block_selection():
+    """Block-default tiers (r3 re-sweep, PROFILE.md): training fwd+bwd gets
+    (1024,1024) whenever it divides; non-dividing seqs fall to smaller tiers
+    through flash_supported (single source of truth); prefill (fwd-only)
+    keeps the fwd-tuned (256,512) per-side independently."""
+    from neuronx_distributed_tpu.kernels.flash_attn import (
+        default_attention_blocks,
+        default_prefill_blocks,
+        flash_supported,
+    )
+
+    assert default_attention_blocks(2048) == (1024, 1024)
+    assert default_attention_blocks(8192) == (1024, 1024)
+    assert default_attention_blocks(1536) == (512, 512)   # 1536 % 1024 != 0
+    # seqs <= the tier clamp to themselves (same contract as before)
+    assert default_attention_blocks(768) == (768, 768)
+    assert default_prefill_blocks(2048) == (256, 512)
+    assert default_prefill_blocks(768) == (256, 768)      # per-side choice
+    # every returned pair must satisfy the kernel's divisibility predicate
+    for s in (256, 512, 768, 1536, 2048, 4096, 8192, 32768):
+        bq, bk = default_attention_blocks(s)
+        assert flash_supported(s, s, bq, bk), (s, bq, bk)
+        bq, bk = default_prefill_blocks(s)
+        assert flash_supported(s, s, bq, bk), (s, bq, bk)
+
+
+def test_decode_config_picks_prefill_blocks():
+    """decode-mode blocks_for routes to the fwd-tuned defaults."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    train_cfg = LlamaConfig(max_seq_len=2048)
+    serve_cfg = LlamaConfig(max_seq_len=2048, decode=True)
+    assert train_cfg.blocks_for(2048) == (1024, 1024)
+    assert serve_cfg.blocks_for(2048) == (256, 512)

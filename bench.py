@@ -195,7 +195,15 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
         prompt = jnp.asarray(
             np.random.RandomState(0).randint(1, 32000, (1, prompt_len)), jnp.int32)
 
-        # TTFT: prefill -> last-token logits -> greedy token on host
+        # TTFT: prefill -> last-token logits -> greedy token on host.
+        # 3 UNTIMED warmups first: the first executions of a fresh program
+        # pay one-off tunnel/program-upload costs that once made L=1 measure
+        # SLOWER than L=2 (an interleaved probe confirmed warm-state L1 <
+        # L2 at the physical ~13 ms/layer slope) — min-over-trials cannot
+        # recover from a systematically cold window.
+        for _ in range(3):
+            logits, cache = lm._prefill[prompt_len](lm.params, prompt)
+            int(jnp.argmax(logits[0, -1]))
         ts = []
         for _ in range(trials):
             t0 = time.perf_counter()
@@ -240,6 +248,9 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
         "ttft_fit_residual_ms": ms(ttft_min_resid),
         "ttft_p50_fit_residual_ms": ms(ttft_p50_resid),
         "decode_ms_per_token_13b_projected": ms(decode_proj),
+        # the fit intercept absorbs the harness's host<->TPU tunnel roundtrip
+        # (~80-100ms here): serving-stack latency a real deployment would not
+        # pay per token; per-depth raw arrays below allow re-analysis
         "ttft_prompt_len": prompt_len,
         "ttft_fit_depths": list(map(int, sorted(prefill_min))),
         "ttft_min_ms_measured": {str(k): ms(v) for k, v in sorted(prefill_min.items())},

@@ -189,7 +189,26 @@ def config_from_hf(path: str):
 
     with open(os.path.join(path, "config.json") if os.path.isdir(path) else path) as f:
         hc = json.load(f)
+    scaling = None
+    rs = hc.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type", rs.get("type"))
+    if rs and rs_type != "llama3":
+        # refusing beats silently-wrong long-context logits
+        raise NotImplementedError(
+            f"rope_scaling type {rs_type!r} not supported (llama3 only); "
+            "linear/yarn/dynamic/longrope need their own frequency maps")
+    if rs_type == "llama3":  # Llama-3.1+ checkpoints
+        from neuronx_distributed_tpu.models.llama import RopeScaling
+
+        scaling = RopeScaling(
+            factor=rs.get("factor", 8.0),
+            low_freq_factor=rs.get("low_freq_factor", 1.0),
+            high_freq_factor=rs.get("high_freq_factor", 4.0),
+            original_max_position_embeddings=rs.get(
+                "original_max_position_embeddings", 8192),
+        )
     return LlamaConfig(
+        rope_scaling=scaling,
         vocab_size=hc["vocab_size"],
         hidden_size=hc["hidden_size"],
         intermediate_size=hc["intermediate_size"],

@@ -44,6 +44,19 @@ Dtype = Any
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 piecewise NTK rope scaling (HF ``rope_type: "llama3"``):
+    wavelengths beyond ``original_max_position_embeddings/low_freq_factor``
+    stretch by ``factor``, short wavelengths stay, the band between
+    interpolates smoothly. Frozen dataclass so configs stay hashable."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     hidden_size: int = 4096
@@ -54,6 +67,7 @@ class LlamaConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    rope_scaling: Optional[RopeScaling] = None  # Llama-3.1+ long-context rope
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16        # compute dtype (mixed_precision_config.compute_dtype)
     param_dtype: Any = jnp.float32   # storage dtype (master weights live in optimizer)
@@ -166,10 +180,30 @@ def llama3_8b(**over) -> LlamaConfig:
                         rope_theta=500000.0), over)
 
 
+def llama31_8b(**over) -> LlamaConfig:
+    """Llama-3.1-8B: 3.0 dims + the long-context rope scaling."""
+    return llama3_8b(max_seq_len=over.pop("max_seq_len", 131072),
+                     rope_scaling=over.pop("rope_scaling", RopeScaling()), **over)
+
+
 def rotary_embedding(positions: jax.Array, head_dim: int, theta: float,
-                     dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables for the given positions, (seq, head_dim/2)."""
+                     dtype=jnp.float32,
+                     scaling: Optional[RopeScaling] = None,
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions, (seq, head_dim/2).
+    ``scaling`` applies the Llama-3.1 piecewise frequency stretch (matches
+    transformers' ``_compute_llama3_parameters``)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        s = scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wl = s.original_max_position_embeddings / s.low_freq_factor
+        high_wl = s.original_max_position_embeddings / s.high_freq_factor
+        smooth = (s.original_max_position_embeddings / wavelen - s.low_freq_factor) / (
+            s.high_freq_factor - s.low_freq_factor)
+        interp = (1.0 - smooth) * inv_freq / s.factor + smooth * inv_freq
+        inv_freq = jnp.where(wavelen > low_wl, inv_freq / s.factor,
+                             jnp.where(wavelen < high_wl, inv_freq, interp))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., s, d/2)
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
@@ -317,7 +351,8 @@ class LlamaAttention(nn.Module):
         else:
             positions = idx[:, None] + chunk_positions[None, :].astype(jnp.int32)
         rows = jnp.arange(b)[:, None]
-        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype)
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=q.dtype,
+                                    scaling=cfg.rope_scaling)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         ck.value = ck.value.at[rows, slots].set(k.astype(ck.value.dtype))
@@ -483,7 +518,8 @@ class LlamaModel(nn.Module):
         else:
             positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
         # cos/sin computed ONCE here (not per scanned layer) and broadcast
-        rope = rotary_embedding(positions, cfg.rope_dims, cfg.rope_theta, dtype=x.dtype)
+        rope = rotary_embedding(positions, cfg.rope_dims, cfg.rope_theta,
+                                dtype=x.dtype, scaling=cfg.rope_scaling)
         if cfg.context_parallel:
             if cfg.sequence_parallel:
                 raise ValueError("sequence_parallel and context_parallel are exclusive")

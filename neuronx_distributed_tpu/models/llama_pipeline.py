@@ -114,7 +114,8 @@ class PipelinedLlama:
         seq = sample_ids.shape[1]
         x_sample = jnp.zeros((sample_ids.shape[0], seq, cfg.hidden_size), cfg.dtype)
         rope = rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
-                                cfg.rope_theta, dtype=cfg.dtype)
+                                cfg.rope_theta, dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling)
         return x_sample, rope
 
     def init(self, rng: jax.Array, sample_ids: jax.Array) -> PyTree:
@@ -187,7 +188,8 @@ class PipelinedLlama:
             raise ValueError(
                 f"sequence length {seq} exceeds max_seq_len {cfg.max_seq_len}")
         return rotary_embedding(jnp.arange(seq, dtype=jnp.int32), cfg.head_dim_,
-                                cfg.rope_theta, dtype=cfg.dtype)
+                                cfg.rope_theta, dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling)
 
     def _embed_and_rope(self, params, input_ids):
         x = self._embed.apply({"params": params["embed"]}, input_ids)

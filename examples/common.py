@@ -71,6 +71,9 @@ def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--seq_len", type=int, default=None)
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--warmup_steps", type=int, default=0)
+    parser.add_argument("--grad_accum_usteps", type=int, default=1,
+                        help="microbatch accumulation inside the jitted step "
+                             "(reference run_llama_nxd_ptl.py:171)")
     parser.add_argument("--lr", type=float, default=1e-4)
     parser.add_argument("--weight_decay", type=float, default=0.01)
     parser.add_argument("--seed", type=int, default=0)

@@ -85,7 +85,8 @@ def main(argv=None) -> float:
             deterministic=False, rngs={"dropout": rng},
         )
 
-    step = make_train_step(model, opt, loss_fn)
+    step = make_train_step(model, opt, loss_fn,
+                           grad_accum_steps=args.grad_accum_usteps)
     state, metrics = train_loop(
         step, state, batches, steps,
         batch_size=batch, log_every=args.log_every,

@@ -163,7 +163,8 @@ def main(argv=None) -> float:
         return model.module.apply(
             {"params": params}, b["ids"], b["labels"], method=LlamaForCausalLM.loss)
 
-    step = make_train_step(model, opt, loss_fn)
+    step = make_train_step(model, opt, loss_fn,
+                           grad_accum_steps=args.grad_accum_usteps)
     state, metrics = train_loop(
         step, state, batches, steps,
         batch_size=batch, log_every=args.log_every,

@@ -59,6 +59,7 @@ def make_train_step(
     optimizer: NxDOptimizer,
     loss_fn: Callable[..., jax.Array],
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted step.
 
@@ -67,7 +68,13 @@ def make_train_step(
     (use ``mesh.data_pspec()``) — GSPMD then emits the DP grad all-reduce
     inside this same program (reference ``bucket_allreduce_gradients``
     equivalence, see parallel/grads.py).
-    """
+
+    ``grad_accum_steps > 1`` (the reference's ``grad_accum_usteps``,
+    run_llama_nxd_ptl.py:171 / module_llama.py:105): the batch's leading dim
+    splits into that many microbatches and a ``lax.scan`` accumulates
+    fp32-mean gradients INSIDE this one program — one optimizer update, one
+    DP all-reduce, no per-microbatch host roundtrips (the reference loops
+    eagerly and divides the loss by the accumulation count)."""
     mesh = model.mesh
     param_shardings = model.trainable_shardings()
 
@@ -90,7 +97,37 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch: PyTree, rng: jax.Array):
         grad_fn = jax.value_and_grad(loss_fn)
-        loss, grads = grad_fn(state.params, batch, rng)
+        if grad_accum_steps > 1:
+            lead = jax.tree.leaves(batch)[0].shape[0]
+            if lead % grad_accum_steps:
+                raise ValueError(
+                    f"batch leading dim {lead} not divisible by "
+                    f"grad_accum_steps={grad_accum_steps}")
+            micro = jax.tree.map(
+                lambda x: x.reshape(grad_accum_steps,
+                                    x.shape[0] // grad_accum_steps,
+                                    *x.shape[1:]),
+                batch)
+
+            def accum(carry, mb_rng):
+                loss_acc, grads_acc = carry
+                mb, r = mb_rng
+                loss_i, grads_i = grad_fn(state.params, mb, r)
+                return (loss_acc + loss_i.astype(jnp.float32),
+                        jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     grads_acc, grads_i)), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss, grads32), _ = jax.lax.scan(
+                accum, (jnp.float32(0.0), zeros),
+                (micro, jax.random.split(rng, grad_accum_steps)))
+            loss = loss / grad_accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / grad_accum_steps).astype(p.dtype),
+                grads32, state.params)
+        else:
+            loss, grads = grad_fn(state.params, batch, rng)
         metrics = {"loss": loss}
         if optimizer.grad_clipping:
             grads, grad_norm = clip_grad_norm(grads, optimizer.max_grad_norm)

@@ -102,3 +102,58 @@ def test_zero1_state_is_dp_sharded():
     ndevs_with_data = len({s.index for s in leaf.addressable_shards})
     assert ndevs_with_data > 2, f"opt state not ZeRO-sharded: {leaf.sharding}"
     ps.destroy_model_parallel()
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum_steps=2 inside the jitted step (lax.scan accumulation)
+    must reproduce the full-batch step exactly when microbatch losses are
+    equal-weight (mean-of-means == global mean; the same contract the
+    reference's loss/grad_accum_steps division assumes,
+    module_llama.py:105)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        create_train_state,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+        neuronx_distributed_config,
+    )
+
+    cfg = neuronx_distributed_config(tensor_parallel_size=2)
+    # fp32 compute: in bf16 the per-microbatch rounding alone perturbs grads
+    # ~3e-4, which adam's m/sqrt(v) normalization amplifies to lr-scale param
+    # diffs — the identity under test is the fp32 algebraic one
+    lcfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                       num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=16,
+                       use_flash_attention=False, remat_policy=None,
+                       dtype=jnp.float32)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 128, (8, 16)))
+    labels = jnp.asarray(rs.randint(0, 128, (8, 16)))  # all valid: exact split
+    model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg), ids)
+    opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-2,
+                                        weight_decay=0.0)
+
+    def loss_fn(params, b, rng):
+        return model.module.apply({"params": params}, b["ids"], b["labels"],
+                                  method=LlamaForCausalLM.loss)
+
+    batch = {"ids": ids, "labels": labels}
+    s_full = create_train_state(model, opt)
+    s_acc = jax.tree.map(lambda x: x, s_full)  # same init
+    # donate=False: both steps consume the SAME initial state buffers
+    step_full = make_train_step(model, opt, loss_fn, donate=False)
+    step_acc = make_train_step(model, opt, loss_fn, grad_accum_steps=2,
+                               donate=False)
+    s_full, m_full = step_full(s_full, batch, jax.random.key(0))
+    s_acc, m_acc = step_acc(s_acc, batch, jax.random.key(0))
+    np.testing.assert_allclose(float(m_acc["loss"]), float(m_full["loss"]),
+                               rtol=1e-6)
+    worst = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b_: float(jnp.max(jnp.abs(a - b_))), s_acc.params, s_full.params)))
+    assert worst < 1e-5, f"params diverged after one update: {worst}"

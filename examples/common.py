@@ -91,6 +91,22 @@ def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return parser
 
 
+def make_lr(args, steps: int):
+    """LR for the examples: constant when --warmup_steps is 0, else linear
+    warmup -> cosine decay to 10% (the reference's CosineAnnealing-with-
+    warmup, examples/training/llama/lr.py, wired via --warmup_steps). The
+    returned optax schedule passes straight through
+    ``initialize_parallel_optimizer(learning_rate=...)``."""
+    if not getattr(args, "warmup_steps", 0):
+        return args.lr
+    import optax
+
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=args.lr,
+        warmup_steps=args.warmup_steps, decay_steps=max(steps, args.warmup_steps + 1),
+        end_value=args.lr * 0.1)
+
+
 def setup_distributed(args) -> bool:
     """Join the pod runtime when the launch trio is present (call before any
     mesh/model init). Returns True on a multi-process run. Safe to call

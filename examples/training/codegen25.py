@@ -29,7 +29,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import jax.numpy as jnp
 import numpy as np
 
-from common import add_common_args, maybe_resume, setup_example, train_loop
+from common import make_lr, add_common_args, maybe_resume, setup_example, train_loop
 from neuronx_distributed_tpu.data.loader import TokenShardDataset, write_token_shard
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from neuronx_distributed_tpu.trainer import (
@@ -150,7 +150,7 @@ def main(argv=None) -> float:
     model = initialize_parallel_model(
         nxd_config, lambda: LlamaForCausalLM(lcfg), sample["ids"])
     opt = initialize_parallel_optimizer(
-        nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay)
+        nxd_config, model, learning_rate=make_lr(args, steps), weight_decay=args.weight_decay)
     state = maybe_resume(args.checkpoint_dir, create_train_state(model, opt))
     # mid-epoch resume: the deterministic stream (shard shuffle_seed + FIM
     # seed) is fast-forwarded past the batches already trained on, so the

@@ -21,6 +21,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import jax.numpy as jnp
 
 from common import (
+    make_lr,
     add_common_args,
     distribute_batches,
     maybe_resume,
@@ -81,7 +82,7 @@ def main(argv=None) -> float:
         nxd_config, lambda: GPTNeoXForCausalLM(ncfg), sample["ids"]
     )
     opt = initialize_parallel_optimizer(
-        nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay
+        nxd_config, model, learning_rate=make_lr(args, steps), weight_decay=args.weight_decay
     )
     state = maybe_resume(args.checkpoint_dir, create_train_state(model, opt))
 

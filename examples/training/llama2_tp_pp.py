@@ -25,6 +25,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import jax.numpy as jnp
 
 from common import (
+    make_lr,
     add_common_args,
     distribute_batches,
     maybe_resume,
@@ -89,7 +90,7 @@ def main(argv=None) -> float:
                             num_chunks=args.num_chunks)
     model = pmodel.as_parallel_model(jnp.asarray(sample["ids"]), seed=args.seed)
     opt = initialize_parallel_optimizer(
-        nxd_config, model, learning_rate=args.lr, weight_decay=args.weight_decay
+        nxd_config, model, learning_rate=make_lr(args, steps), weight_decay=args.weight_decay
     )
     state = maybe_resume(args.checkpoint_dir, create_train_state(model, opt))
 

@@ -389,16 +389,14 @@ def default_attention_blocks(sq: int) -> tuple:
 
 
 def default_prefill_blocks(sq: int) -> tuple:
-    """(block_q, block_k) for FORWARD-ONLY use (inference prefill): the fwd
-    kernel alone prefers smaller q blocks — measured 34.7ms (256,512) vs
-    39.1ms (1024,1024) at b8/s2048/32h/128d — while training's combined
-    fwd+bwd strongly prefers (1024,1024) (see default_attention_blocks).
-    Sides are chosen independently: ``blocks_for`` consumes ``pick(sq)[0]``
-    and ``pick(sk)[1]`` separately."""
-    fallback = default_attention_blocks(sq)
-    bq = 256 if flash_supported(sq, sq, 256, 256) else fallback[0]
-    bk = 512 if flash_supported(sq, sq, 512, 512) else fallback[1]
-    return bq, bk
+    """(block_q, block_k) for FORWARD-ONLY use (inference prefill). An
+    early sequential sweep suggested small q blocks win the fwd kernel; a
+    clean INTERLEAVED re-measurement (tunnel drift hitting every config
+    equally, b8/s2048/32h/128d) showed (1024,1024) wins fwd-only as well —
+    81.5ms vs 104.9ms for (256,512) incl. the constant host roundtrip — so
+    prefill shares the fwd+bwd tiers. Kept as a separate hook: fwd-only
+    tuning has its own measurement history and may diverge again."""
+    return default_attention_blocks(sq)
 
 
 def flash_supported(sq: int, sk: int, block_q: int, block_k: int) -> bool:

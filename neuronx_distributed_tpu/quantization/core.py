@@ -126,13 +126,14 @@ def dequantize_leaf(value, dtype):
     return value
 
 
-# Known limit: quantized trees are a SERVING feature (decode-mode models,
-# fwd-tuned flash blocks). Feeding one through a TRAINING-style forward with
-# the large (1024,1024) fwd+bwd flash blocks at 13B dims trips an XLA:TPU
-# runtime fault (Internal) on v5-lite — the serving paths (CausalLM prefill/
-# decode, which select default_prefill_blocks) and all smaller configs are
-# unaffected. Dequantize with dequantize_params first if a full-size
-# training-style forward over a quantized tree is ever needed.
+# Known limit: quantized trees are a SERVING feature. Feeding one through a
+# TRAINING-style forward (the full differentiable program) with (1024,1024)
+# flash blocks at 13B dims trips an XLA:TPU runtime fault (Internal) on
+# v5-lite. The serving paths are verified unaffected: CausalLM's fwd-only
+# flash prefill at 13B dims with 1024-wide q blocks over a quantized tree
+# runs clean on the chip (r3 probe), as do all smaller configs. Dequantize
+# with dequantize_params first if a full-size training-style forward over a
+# quantized tree is ever needed.
 
 
 def dequantize_params(qparams: PyTree, dtype=jnp.bfloat16) -> PyTree:

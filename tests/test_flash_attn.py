@@ -193,10 +193,11 @@ def test_masked_gradients():
 
 
 def test_default_block_selection():
-    """Block-default tiers (r3 re-sweep, PROFILE.md): training fwd+bwd gets
-    (1024,1024) whenever it divides; non-dividing seqs fall to smaller tiers
-    through flash_supported (single source of truth); prefill (fwd-only)
-    keeps the fwd-tuned (256,512) per-side independently."""
+    """Block-default tiers (r3 re-sweep + interleaved correction,
+    PROFILE.md): (1024,1024) whenever it divides — for training fwd+bwd AND
+    fwd-only prefill (the interleaved re-measurement showed big blocks win
+    both); non-dividing seqs fall to smaller tiers through flash_supported
+    (single source of truth)."""
     from neuronx_distributed_tpu.kernels.flash_attn import (
         default_attention_blocks,
         default_prefill_blocks,
@@ -208,8 +209,10 @@ def test_default_block_selection():
     assert default_attention_blocks(1536) == (512, 512)   # 1536 % 1024 != 0
     # seqs <= the tier clamp to themselves (same contract as before)
     assert default_attention_blocks(768) == (768, 768)
-    assert default_prefill_blocks(2048) == (256, 512)
-    assert default_prefill_blocks(768) == (256, 768)      # per-side choice
+    # interleaved re-measurement showed big blocks win fwd-only too:
+    # prefill shares the training tiers (default_prefill_blocks docstring)
+    assert default_prefill_blocks(2048) == (1024, 1024)
+    assert default_prefill_blocks(768) == (768, 768)
     # every returned pair must satisfy the kernel's divisibility predicate
     for s in (256, 512, 768, 1536, 2048, 4096, 8192, 32768):
         bq, bk = default_attention_blocks(s)
@@ -218,11 +221,18 @@ def test_default_block_selection():
         assert flash_supported(s, s, bq, bk), (s, bq, bk)
 
 
-def test_decode_config_picks_prefill_blocks():
-    """decode-mode blocks_for routes to the fwd-tuned defaults."""
+def test_decode_config_picks_prefill_blocks(monkeypatch):
+    """decode-mode blocks_for routes through default_prefill_blocks (today
+    it delegates to the shared tiers, so the dispatch is asserted by
+    diverging the hook — a future fwd-only re-tune must land in decode
+    configs and ONLY there)."""
+    from neuronx_distributed_tpu.kernels import flash_attn as fa
     from neuronx_distributed_tpu.models.llama import LlamaConfig
 
     train_cfg = LlamaConfig(max_seq_len=2048)
     serve_cfg = LlamaConfig(max_seq_len=2048, decode=True)
     assert train_cfg.blocks_for(2048) == (1024, 1024)
-    assert serve_cfg.blocks_for(2048) == (256, 512)
+    assert serve_cfg.blocks_for(2048) == (1024, 1024)
+    monkeypatch.setattr(fa, "default_prefill_blocks", lambda sq: (256, 512))
+    assert serve_cfg.blocks_for(2048) == (256, 512)   # decode follows the hook
+    assert train_cfg.blocks_for(2048) == (1024, 1024)  # training does not

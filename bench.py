@@ -213,15 +213,20 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
         prefill_min[layers] = float(np.min(ts))
         prefill_p50[layers] = float(np.percentile(ts, 50))
 
-        def decode_window(lm_, cache_):
+        def decode_window(lm_, cache_, windows=3):
+            # min over independent windows: one tunnel latency spike inside a
+            # single window once swung the int8 projection 22 -> 83 ms/tok
             tok = jnp.zeros((1, 1), jnp.int32)
             logits_, cache_ = lm_._decode(lm_.params, cache_, tok)
             float(logits_[0, 0, 0])
-            t0 = time.perf_counter()
-            for _ in range(decode_steps):
-                logits_, cache_ = lm_._decode(lm_.params, cache_, tok)
-            float(logits_[0, 0, 0])
-            return (time.perf_counter() - t0) / decode_steps
+            best = float("inf")
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                for _ in range(decode_steps):
+                    logits_, cache_ = lm_._decode(lm_.params, cache_, tok)
+                float(logits_[0, 0, 0])
+                best = min(best, (time.perf_counter() - t0) / decode_steps)
+            return best
 
         decode_t[layers] = decode_window(lm, cache)
 
@@ -248,6 +253,11 @@ def bench_inference_ttft(prompt_len=2048, depths=(1, 2, 4, 6), trials=15,
         "ttft_fit_residual_ms": ms(ttft_min_resid),
         "ttft_p50_fit_residual_ms": ms(ttft_p50_resid),
         "decode_ms_per_token_13b_projected": ms(decode_proj),
+        # estimator note: r3 changed decode timing from one window's mean to
+        # MIN over 3 window means (same additive-noise rationale as the
+        # prefill minfit keys) — do not read cross-round decode deltas as
+        # pure model speedup without checking this basis
+        "decode_basis": "min_of_3_window_means",
         # the fit intercept absorbs the harness's host<->TPU tunnel roundtrip
         # (~80-100ms here): serving-stack latency a real deployment would not
         # pay per token; per-depth raw arrays below allow re-analysis

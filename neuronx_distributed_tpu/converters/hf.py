@@ -219,8 +219,12 @@ def hf_to_nxd_neox(hf: Dict[str, np.ndarray], config,
         }
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = {"kernel": _np(
-            hf.get("embed_out.weight", hf["gpt_neox.embed_in.weight"])).T}
+        if "embed_out.weight" not in hf:
+            raise KeyError(
+                "gpt_neox checkpoint has tie_word_embeddings=False but no "
+                "'embed_out.weight' — refusing to substitute the input "
+                "embedding as the lm_head")
+        params["lm_head"] = {"kernel": _np(hf["embed_out.weight"]).T}
     return _to_jnp(params, dt)
 
 
@@ -567,8 +571,16 @@ def main(argv=None):
     else:
         if args.model == "auto":
             raise SystemExit("--direction nxd2hf requires an explicit --model")
+        if not args.config:
+            # --input is a framework checkpoint dir with no config.json;
+            # without --config the failure would surface as an opaque
+            # FileNotFoundError deep inside _read_hf_config
+            raise SystemExit(
+                "--direction nxd2hf requires --config pointing at the HF "
+                "model dir (the framework checkpoint under --input has no "
+                "config.json)")
         fam = FAMILIES[args.model]
-        cfg = fam.config_from_hf(args.config or args.input)
+        cfg = fam.config_from_hf(args.config)
         from neuronx_distributed_tpu.checkpoint import load_checkpoint
 
         state, _ = load_checkpoint(args.input, tag=args.tag)

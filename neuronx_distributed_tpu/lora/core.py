@@ -37,7 +37,7 @@ class LoraConfig:
 
     r: int = 8
     lora_alpha: float = 16.0
-    lora_dropout: float = 0.0        # weight-space form, see dropout_adapters
+    lora_dropout: float = 0.0        # exact in-activation form, see attach_adapters
     # add "embed" to adapt the token embedding (reference LoraEmbedding,
     # modules/lora/layer.py:245 — in weight space the lookup of W + sAB IS
     # embedding(x, W) + s*(onehot(x) @ A) @ B, the reference's forward)
@@ -119,24 +119,55 @@ def merge_lora(params: PyTree, lora_params: PyTree, config: LoraConfig) -> PyTre
     return jax.tree_util.tree_map_with_path(merge_leaf, params)
 
 
-def dropout_adapters(lora_params: PyTree, config: LoraConfig, rng: jax.Array) -> PyTree:
-    """LoRA dropout in weight space: reference applies dropout(x) @ A
-    (layer.py lora_dropout). Feature-wise dropout of x is exactly a row mask
-    on A (``dropout(x) @ A == x @ (diag(m)/keep @ A)`` when the mask is
-    per-feature); the per-token component of standard dropout is not
-    expressible in weight space, so this is the documented approximation —
-    same expected regularization, shared across the microbatch."""
+def attach_adapters(params: PyTree, lora_params: PyTree, config: LoraConfig,
+                    rng: jax.Array) -> PyTree:
+    """Params tree for the EXACT dropout forward: each targeted linear kernel
+    leaf becomes ``{"base": W, "lora_a": A, "lora_b": s*B, "keep": 1-p,
+    "key": prng}`` which the parallel layers expand in-activation as
+    ``x @ W + (dropout(x) @ A) @ (s*B)`` — the reference's per-token,
+    per-feature ``lora_dropout(x)`` semantics
+    (modules/lora/layer.py:178-179), not a weight-space approximation.
+    All dict entries are arrays (stacked kernels get per-layer split keys),
+    so ``lax.scan`` over stacked layers slices them like any other leaf.
+
+    Embedding adapters are weight-space merged here (dropping out integer
+    ids is meaningless — PEFT's LoraEmbedding skips dropout the same way),
+    as are conv kernels (documented approximation: the conv factorization
+    has no in-activation form under this parameter layout).
+    """
     if config.lora_dropout <= 0.0:
-        return lora_params
+        return merge_lora(params, lora_params, config)
     keep = 1.0 - config.lora_dropout
-    out = {}
-    for i, (pstr, ad) in enumerate(sorted(lora_params.items())):
-        # per fan-in-feature mask (per layer when stacked): A is (..., in, r)
-        mask = jax.random.bernoulli(
-            jax.random.fold_in(rng, i), keep, ad["lora_a"].shape[:-1] + (1,)
-        )
-        out[pstr] = {"lora_a": ad["lora_a"] * mask / keep, "lora_b": ad["lora_b"]}
-    return out
+    keys = {p: jax.random.fold_in(rng, i)
+            for i, p in enumerate(sorted(lora_params))}
+
+    def sub(path, leaf):
+        pstr = jax.tree_util.keystr(path)
+        ad = lora_params.get(pstr)
+        if ad is None:
+            return leaf
+        a = ad["lora_a"]
+        stacked = bool(_STACKED_RE.search(pstr))
+        # discriminate on the CONSUMING kernel's body shape: 2D = parallel
+        # linear, 3D = GQA qkv — the layers that expand attached dicts; 4D
+        # (conv) and the embedding have no in-activation form here
+        leaf_body_ndim = leaf.ndim - int(stacked)
+        if pstr.endswith("mbedding']") or leaf_body_ndim not in (2, 3):
+            # embedding / conv: weight-space merge (see docstring)
+            delta = (a @ ad["lora_b"]) * config.scaling
+            return leaf + delta.reshape(leaf.shape).astype(leaf.dtype)
+        k = keys[pstr]
+        if stacked:
+            key_leaf = jax.random.split(k, a.shape[0])
+            keep_leaf = jnp.full((a.shape[0],), keep, jnp.float32)
+        else:
+            key_leaf = k
+            keep_leaf = jnp.asarray(keep, jnp.float32)
+        return {"base": leaf, "lora_a": a,
+                "lora_b": ad["lora_b"] * config.scaling,
+                "keep": keep_leaf, "key": key_leaf}
+
+    return jax.tree_util.tree_map_with_path(sub, params)
 
 
 def lora_param_specs(lora_params: PyTree, params: PyTree,

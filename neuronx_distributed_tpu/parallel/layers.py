@@ -59,6 +59,27 @@ def divide(numerator: int, denominator: int) -> int:
     return numerator // denominator
 
 
+def _split_lora(kernel):
+    """A LoRA-attached kernel leaf (``lora.core.attach_adapters``) splits
+    into (base_kernel, adapter_dict); plain kernels pass through."""
+    if isinstance(kernel, dict) and "lora_a" in kernel:
+        return kernel["base"], kernel
+    return kernel, None
+
+
+def _lora_delta(x: jax.Array, ad: dict) -> jax.Array:
+    """``(dropout(x) @ A) @ (s*B)`` — the reference's in-activation LoRA
+    forward with EXACT per-token+per-feature dropout
+    (modules/lora/layer.py:178-179); ``keep``/``key`` ride in the adapter
+    dict so scan-stacked layers get per-layer masks under the step rng."""
+    keep = ad["keep"].astype(x.dtype)
+    mask = jax.random.bernoulli(ad["key"], ad["keep"], x.shape)
+    xd = x * mask.astype(x.dtype) / keep
+    a = ad["lora_a"].astype(x.dtype)
+    b = ad["lora_b"].astype(x.dtype)
+    return (xd @ a) @ b
+
+
 class ColumnParallelLinear(nn.Module):
     """Linear with output features sharded over TP (reference layers.py:460).
 
@@ -95,9 +116,12 @@ class ColumnParallelLinear(nn.Module):
         # int8 serving: a {'qweight','scale'} leaf dequantizes HERE — inside
         # the layer (= inside the scan body for stacked models), so the int8
         # weights are what HBM holds and the convert fuses into the matmul
+        kernel, lora = _split_lora(kernel)
         kernel = dequantize_leaf(kernel, self.dtype or self.param_dtype)
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = x @ kernel
+        if lora is not None:
+            y = y + _lora_delta(x, lora)
         if bias is not None:
             y = y + bias.astype(y.dtype)
         y = constrain(y, ACT_FULL if self.gather_output else ACT_TP)
@@ -135,9 +159,14 @@ class RowParallelLinear(nn.Module):
             bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
         if self.input_is_parallel:
             x = constrain(x, ACT_TP)
+        kernel, lora = _split_lora(kernel)
         kernel = dequantize_leaf(kernel, self.dtype or self.param_dtype)
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = x @ kernel
+        if lora is not None:
+            # A contracts the TP-sharded input dim: GSPMD reduces the partial
+            # delta together with the base matmul's partials
+            y = y + _lora_delta(x, lora)
         y = constrain(y, ACT_SP if self.sequence_parallel else ACT_FULL)
         if bias is not None:
             y = y + bias.astype(y.dtype)
@@ -291,6 +320,8 @@ class GQAQKVColumnParallelLinear(nn.Module):
         if self.sequence_parallel:
             x = constrain(x, ACT_SP)
         dq = lambda k: dequantize_leaf(k, self.dtype or self.param_dtype)  # noqa: E731
+        (q_kernel, q_lora), (k_kernel, k_lora), (v_kernel, v_lora) = (
+            _split_lora(q_kernel), _split_lora(k_kernel), _split_lora(v_kernel))
         q_kernel, k_kernel, v_kernel = dq(q_kernel), dq(k_kernel), dq(v_kernel)
         x, q_kernel, k_kernel, v_kernel = nn.dtypes.promote_dtype(
             x, q_kernel, k_kernel, v_kernel, dtype=self.dtype
@@ -301,6 +332,20 @@ class GQAQKVColumnParallelLinear(nn.Module):
         q = jnp.einsum("bsh,hnd->bsnd", x, q_kernel)
         k = jnp.einsum("bsh,hnd->bsnd", x, k_kernel)
         v = jnp.einsum("bsh,hnd->bsnd", x, v_kernel)
+
+        def add_delta(y, lora, heads):
+            # adapter fan_out is the flattened (heads, head_dim); the KV
+            # delta is computed COMPACT then head-repeated like the kernels
+            if lora is None:
+                return y
+            d = _lora_delta(x, lora).reshape(*x.shape[:-1], heads, self.head_dim)
+            if heads != y.shape[-2]:
+                d = jnp.repeat(d, self.kv_size_multiplier, axis=-2)
+            return y + d
+
+        q = add_delta(q, q_lora, self.num_heads)
+        k = add_delta(k, k_lora, self.num_kv_heads)
+        v = add_delta(v, v_lora, self.num_kv_heads)
         if self.use_bias:
             # per-head biases, K/V compact like the kernels (reference
             # qkv_linear.py biases; NeoX/BERT QKV carry biases)

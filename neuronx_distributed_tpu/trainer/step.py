@@ -60,6 +60,7 @@ def make_train_step(
     loss_fn: Callable[..., jax.Array],
     donate: bool = True,
     grad_accum_steps: int = 1,
+    optimizer_kernel: Optional[bool] = None,
 ) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted step.
 
@@ -77,23 +78,62 @@ def make_train_step(
     eagerly and divides the loss by the accumulation count)."""
     mesh = model.mesh
     param_shardings = model.trainable_shardings()
+    opt_shardings = _opt_state_shardings(model, optimizer)
+    # Pallas optimizer kernel (optimizer/fused_kernel.py): OPT-IN only.
+    # Measured on-chip at the bench shapes (PROFILE.md round 4) the
+    # per-block pipeline overhead made it ~2x slower than XLA's fused
+    # elementwise chain — the declarative path already sits near the HBM
+    # roofline here. Kept as an option (and CI-covered under the Pallas
+    # interpreter) because the shard_map + ZeRO-resharding harness is the
+    # right structure if a future Mosaic revision changes the tradeoff.
+    if optimizer_kernel is None:
+        optimizer_kernel = False
+    use_kernel = optimizer_kernel and hasattr(optimizer.tx, "update_and_params_local")
+    # per-leaf ZeRO resharding plan: (dim, extra DP axes) where the state
+    # spec shards a dim beyond the param spec, else None
+    _kernel_plan: Dict[str, Any] = {}
+    if use_kernel:
+        from neuronx_distributed_tpu.optimizer.zero1 import _entry_axes
+
+        pflat = jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+        sflat = jax.tree_util.tree_flatten_with_path(opt_shardings.master)[0]
+        for (ppath, psh), (_, ssh) in zip(pflat, sflat):
+            pe, se = list(psh.spec), list(ssh.spec)
+            ndim = max(len(pe), len(se))
+            pe += [None] * (ndim - len(pe))
+            se += [None] * (ndim - len(se))
+            plan = None
+            for d in range(ndim):
+                pa, sa = _entry_axes(pe[d]), _entry_axes(se[d])
+                if tuple(sa) != tuple(pa):
+                    if tuple(sa[: len(pa)]) != tuple(pa):
+                        raise ValueError(
+                            f"state spec {se} does not extend param spec {pe}")
+                    plan = (d, tuple(sa[len(pa):]))
+                    break
+            _kernel_plan[jax.tree_util.keystr(ppath)] = plan
 
     if model.lora_config is not None:
-        # LoRA: state.params is the adapter tree; merge W + scale*A@B inside
-        # the step so loss_fn sees full params, and differentiate w.r.t. the
+        # LoRA: state.params is the adapter tree; the step builds full params
+        # from it so loss_fn is unchanged, and differentiates w.r.t. the
         # adapters only — the base (closed over) gets no gradient, no
         # optimizer state, and cannot drift (reference requires_grad freeze,
-        # modules/lora/model.py:175).
+        # modules/lora/model.py:175). With dropout the adapters are ATTACHED
+        # (in-activation dropout(x)@A@B inside the layers — exact reference
+        # semantics, lora/layer.py:178-179); otherwise merged into W.
         inner_loss = loss_fn
         lora_cfg = model.lora_config
 
         def loss_fn(lora_tree, batch, rng):  # noqa: F811
             if lora_cfg.lora_dropout > 0.0:
-                from neuronx_distributed_tpu.lora.core import dropout_adapters
+                from neuronx_distributed_tpu.lora.core import attach_adapters
 
                 drop_rng, rng = jax.random.split(rng)
-                lora_tree = dropout_adapters(lora_tree, lora_cfg, drop_rng)
-            return inner_loss(model.merged_params(lora_tree), batch, rng)
+                params = attach_adapters(
+                    model.params, lora_tree, lora_cfg, drop_rng)
+            else:
+                params = model.merged_params(lora_tree)
+            return inner_loss(params, batch, rng)
 
     def step_fn(state: TrainState, batch: PyTree, rng: jax.Array):
         grad_fn = jax.value_and_grad(loss_fn)
@@ -129,11 +169,77 @@ def make_train_step(
         else:
             loss, grads = grad_fn(state.params, batch, rng)
         metrics = {"loss": loss}
+        fused = hasattr(optimizer.tx, "update_and_params")
+        scale = None
         if optimizer.grad_clipping:
-            grads, grad_norm = clip_grad_norm(grads, optimizer.max_grad_norm)
+            if fused:
+                # fused path: compute the norm (one read pass) but fold the
+                # clip SCALE into the optimizer's grad cast — the clipped
+                # grad tree is never written to HBM
+                from neuronx_distributed_tpu.parallel.grads import get_grad_norm
+
+                grad_norm = get_grad_norm(grads)
+                # same coefficient as clip_grads_with_norm (grads.py); the
+                # scale is applied in the optimizer's fp32 grad cast, skipping
+                # the classic path's bf16 round-trip of the scaled grads
+                scale = jnp.clip(
+                    optimizer.max_grad_norm / (grad_norm + 1e-6), max=1.0)
+            else:
+                grads, grad_norm = clip_grad_norm(grads, optimizer.max_grad_norm)
             metrics["grad_norm"] = grad_norm
-        updates, new_opt_state = optimizer.tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if fused and use_kernel:
+            # single-pass Pallas kernel per leaf, under shard_map (GSPMD
+            # cannot partition a pallas_call): every device updates its own
+            # STATE shard. ZeRO-1 state is more sharded than the params, so
+            # the wrapper performs the operational ZeRO dataflow explicitly:
+            # slice this device's state-shard of the (replicated-over-DP)
+            # grads, update, then all-gather the new param shards back to
+            # the param layout — the same reduce-scatter/all-gather schedule
+            # GSPMD derives on the declarative path.
+            specs_p = jax.tree.map(lambda s: s.spec, param_shardings)
+            specs_s = jax.tree.map(lambda s: s.spec, opt_shardings)
+
+            def to_state_shard(path, g):
+                plan = _kernel_plan.get(jax.tree_util.keystr(path))
+                if plan is None:
+                    return g
+                d, axes = plan
+                n, idx = 1, jnp.int32(0)
+                for ax in axes:
+                    n *= jax.lax.axis_size(ax)
+                    idx = idx * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+                shard = g.shape[d] // n
+                return jax.lax.dynamic_slice_in_dim(g, idx * shard, shard, d)
+
+            def to_param_shard(path, p):
+                plan = _kernel_plan.get(jax.tree_util.keystr(path))
+                if plan is None:
+                    return p
+                d, axes = plan
+                return jax.lax.all_gather(p, axes, axis=d, tiled=True)
+
+            def local_update(g, s, p, sc):
+                g = jax.tree_util.tree_map_with_path(to_state_shard, g)
+                p_dt = jax.tree_util.tree_map_with_path(to_state_shard, p)
+                new_p, new_s = optimizer.tx.update_and_params_local(
+                    g, s, p_dt, scale=sc)
+                return jax.tree_util.tree_map_with_path(to_param_shard, new_p), new_s
+
+            new_params, new_opt_state = jax.shard_map(
+                local_update,
+                mesh=mesh,
+                in_specs=(specs_p, specs_s, specs_p, P()),
+                out_specs=(specs_p, specs_s),
+                check_vma=False,
+            )(grads, state.opt_state, state.params,
+              jnp.float32(1.0) if scale is None else scale)
+        elif fused:
+            new_params, new_opt_state = optimizer.tx.update_and_params(
+                grads, state.opt_state, state.params, scale=scale)
+        else:
+            updates, new_opt_state = optimizer.tx.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
         return new_state, metrics
 
@@ -142,7 +248,7 @@ def make_train_step(
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()),
         params=param_shardings,
-        opt_state=_opt_state_shardings(model, optimizer),
+        opt_state=opt_shardings,
     )
     return jax.jit(
         step_fn,

@@ -66,9 +66,10 @@ class PipelinedLlama:
     remat: bool = True
     num_chunks: int = 1
     # training schedule for the loss path: "1f1b" (reference default,
-    # Train1F1BSchedule — bounded activation stash) or "gpipe" (autodiff'd
-    # forward scan — simpler program, activations grow with microbatches).
-    # VPP (num_chunks > 1) always runs the interleaved engine.
+    # Train1F1BSchedule — bounded activation stash; with num_chunks > 1 the
+    # table-driven INTERLEAVED 1F1B: VPP bubble + 1F1B memory) or "gpipe"
+    # (autodiff'd scan — simpler program, activations grow with
+    # microbatches; num_chunks > 1 runs the interleaved forward engine).
     schedule: str = "1f1b"
 
     def __post_init__(self):
@@ -249,11 +250,14 @@ class PipelinedLlama:
             labels = jnp.where(labels == ignore_index, -100, labels)
         last_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
         labels_mb = microbatch(labels, self.num_microbatches)
-        if self.num_chunks == 1 and self.schedule == "1f1b":
+        if self.schedule == "1f1b":
+            # num_chunks > 1 runs the table-driven interleaved 1F1B engine
+            # (VPP bubble + 1F1B memory); params are already in VPP layout
             cos, sin = self._rope(input_ids.shape[1])
             run = pipeline_1f1b(
                 self._first_fn, self._stage_fn, self._last_fn,
                 self.num_stages, self.num_microbatches,
+                num_chunks=self.num_chunks,
             )
             ids_mb = microbatch(input_ids, self.num_microbatches)
             acc = run({"embed": params["embed"]}, params["layers"]["block"],

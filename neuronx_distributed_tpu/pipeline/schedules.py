@@ -18,7 +18,7 @@ users the same introspection surface the reference exposes.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,3 +173,174 @@ def interleaved_schedule(
         bi += 1
         yield [BackwardStep(mb, chunk=cb)]
     yield [ReduceGrads(0)]
+
+
+# --- tick-aligned global interleaved 1F1B (drives the SPMD engine) ----------
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalInterleaved1F1B:
+    """Tick-aligned interleaved-1F1B schedule + stash-slot assignment for the
+    table-driven SPMD engine (``engine.pipeline_1f1b`` with chunks > 1).
+
+    Per (tick, rank): at most one forward chunk-unit and one backward
+    chunk-unit. ``exec_f[(m, v)] / exec_b[(m, v)]`` give each virtual-stage
+    unit's tick; ``x_slot/dy_slot`` assign each unit a stash slot on its rank
+    such that lifetimes never overlap (verified at construction). Stash
+    capacity is the schedule's true peak — flat in microbatch count, the
+    1F1B property.
+    """
+
+    pp_size: int
+    num_microbatches: int
+    num_chunks: int
+    ticks: int
+    exec_f: Dict[Tuple[int, int], int]   # (m, v) -> tick
+    exec_b: Dict[Tuple[int, int], int]
+    x_slot: Dict[Tuple[int, int], int]   # (m, v) -> stash slot on rank v%S
+    dy_slot: Dict[Tuple[int, int], int]
+    x_slots: int                          # stash capacities (max over ranks)
+    dy_slots: int
+
+    def units_at(self, t: int, rank: int):
+        """(fwd_unit, bwd_unit) at tick t on rank — each (m, v) or None."""
+        f = next(((m, v) for (m, v), tt in self.exec_f.items()
+                  if tt == t and v % self.pp_size == rank), None)
+        b = next(((m, v) for (m, v), tt in self.exec_b.items()
+                  if tt == t and v % self.pp_size == rank), None)
+        return f, b
+
+
+def interleaved_1f1b_global(
+    pp_size: int, num_microbatches: int, num_chunks: int
+) -> GlobalInterleaved1F1B:
+    """Simulate the interleaved 1F1B schedule with explicit ring latency.
+
+    Model: one global tick runs (≤1 fwd unit + ≤1 bwd unit) per rank; a unit's
+    ring payload (activation forward, dx backward) is available to its
+    neighbor from the NEXT tick. Per rank, forwards issue in the Megatron
+    chunk-block order under the warmup in-flight cap
+    ``2*(S-r-1) + (C-1)*S + 1`` (scheduler.py:256-541 warmup count + 1 in
+    flight during steady state); backwards issue greedily oldest-first —
+    which reproduces 1F1B's alternating steady state and its bounded
+    activation footprint.
+    """
+    S, C, MB = pp_size, num_chunks, num_microbatches
+    if MB % S != 0:
+        raise ValueError(
+            f"interleaved 1F1B requires num_microbatches ({MB}) divisible by "
+            f"pp_size ({S})")
+    V = S * C
+    # per-rank forward issue order (Megatron chunk-block order)
+    fwd_order = [
+        (blk * S + m, chunk)
+        for blk in range(MB // S)
+        for chunk in range(C)
+        for m in range(S)
+    ]
+    cap = [min(2 * (S - r - 1) + (C - 1) * S + 1, C * MB) for r in range(S)]
+
+    exec_f: Dict[Tuple[int, int], int] = {}
+    exec_b: Dict[Tuple[int, int], int] = {}
+    next_f = [0] * S                      # index into fwd_order per rank
+    pend_b: List[List[Tuple[int, int]]] = [[] for _ in range(S)]  # fwd-done, bwd-pending (issue order)
+    in_flight = [0] * S
+    t = 0
+    total_units = S * C * MB
+    while len(exec_b) < total_units:
+        if t > 4 * (total_units + 2 * V):  # safety: schedule must terminate
+            raise RuntimeError("interleaved 1F1B schedule did not converge")
+        # backward first (1F1B drain priority); dy of (m, v) is ready if
+        # v == V-1 and its OWN forward runs this tick (loss vjp, same tick),
+        # or the downstream backward ran at a strictly earlier tick.
+        for r in range(S):
+            i = next_f[r]
+            if i < len(fwd_order):
+                m, c = fwd_order[i]
+                v = c * S + r
+                ready = v == 0 or exec_f.get((m, v - 1), t) < t
+                if ready and in_flight[r] < cap[r]:
+                    exec_f[(m, v)] = t
+                    next_f[r] += 1
+                    in_flight[r] += 1
+                    pend_b[r].append((m, v))
+        for r in range(S):
+            chosen: Optional[Tuple[int, int]] = None
+            for u in pend_b[r]:           # oldest-first
+                m, v = u
+                if v == V - 1:
+                    ready = exec_f[u] <= t
+                else:
+                    ready = exec_b.get((m, v + 1), t) < t
+                if ready:
+                    chosen = u
+                    break
+            if chosen is not None:
+                exec_b[chosen] = t
+                pend_b[r].remove(chosen)
+                in_flight[r] -= 1
+        t += 1
+    ticks = t
+
+    def alloc(lifetimes: Dict[Tuple[int, int], Tuple[int, int, int]]):
+        """Greedy per-rank slot assignment; lifetime = [birth, death] ticks
+        inclusive. Returns (slot map, max slots over ranks)."""
+        slot: Dict[Tuple[int, int], int] = {}
+        peak = 0
+        for r in range(S):
+            units = sorted(
+                (u for u, (rr, _, _) in lifetimes.items() if rr == r),
+                key=lambda u: (lifetimes[u][1], u))
+            free: List[int] = []
+            nslots = 0
+            releases: List[Tuple[int, int]] = []  # (death, slot)
+            for u in units:
+                _, birth, death = lifetimes[u]
+                releases.sort()
+                while releases and releases[0][0] < birth:
+                    free.append(releases.pop(0)[1])
+                if free:
+                    s = free.pop(0)
+                else:
+                    s = nslots
+                    nslots += 1
+                slot[u] = s
+                releases.append((death, s))
+            peak = max(peak, nslots)
+        return slot, peak
+
+    # x stash on rank v%S: input of fwd unit (m, v). Born when it lands in the
+    # stash (ring arrival for v>0, the unit's own tick for v==0), dies after
+    # the backward's vjp replay reads it.
+    x_life = {
+        (m, v): (v % S,
+                 exec_f[(m, v)] if v == 0 else exec_f[(m, v - 1)] + 1,
+                 exec_b[(m, v)])
+        for (m, v) in exec_f
+    }
+    # dy stash on rank v%S: cotangent consumed by bwd unit (m, v). Born at the
+    # loss vjp tick (v == V-1) or ring arrival, dies when the backward runs.
+    dy_life = {
+        (m, v): (v % S,
+                 exec_f[(m, v)] if v == V - 1 else exec_b[(m, v + 1)] + 1,
+                 exec_b[(m, v)])
+        for (m, v) in exec_b
+    }
+    x_slot, x_slots = alloc(x_life)
+    dy_slot, dy_slots = alloc(dy_life)
+
+    # sanity: no two units sharing a slot may have overlapping lifetimes
+    for life, slots in ((x_life, x_slot), (dy_life, dy_slot)):
+        by_rs: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+        for u, (r, b, d) in life.items():
+            by_rs.setdefault((r, slots[u]), []).append((b, d))
+        for spans in by_rs.values():
+            spans.sort()
+            for (b1, d1), (b2, d2) in zip(spans, spans[1:]):
+                if b2 <= d1:
+                    raise AssertionError("stash slot lifetime overlap")
+
+    return GlobalInterleaved1F1B(
+        pp_size=S, num_microbatches=MB, num_chunks=C, ticks=ticks,
+        exec_f=exec_f, exec_b=exec_b, x_slot=x_slot, dy_slot=dy_slot,
+        x_slots=x_slots, dy_slots=dy_slots)

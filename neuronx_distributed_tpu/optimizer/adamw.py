@@ -11,7 +11,7 @@ master copy shards under the ZeRO-1 plan like any other state leaf.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +23,30 @@ class FP32MasterState(NamedTuple):
     mu: optax.Updates
     nu: optax.Updates
     master: optax.Params  # fp32 copies of the (possibly bf16) params
+
+
+class FusedGradientTransformation(NamedTuple):
+    """optax-compatible (init, update) plus ``update_and_params``: a single
+    pass that emits NEW PARAMS directly instead of an updates tree. The
+    classic contract costs three extra HBM passes over the params on every
+    step (read p to form ``cast(master)-p``, write updates, then
+    ``apply_updates``'s read-read-write) — pure bandwidth on an already
+    bandwidth-bound stage (PROFILE.md: optimizer ~45 ms vs ~20 ms floor).
+    The fused form writes ``p_new = cast(master_new)`` without ever reading
+    the old params, and folds the grad-clip SCALE in (the norm reduction
+    still reads the grads once, but the scaled-grad tree is never
+    materialized)."""
+
+    init: Callable
+    update: Callable
+    # (grads, state, params, scale=None) -> (new_params, new_state)
+    update_and_params: Callable
+    # LOCAL-shard form for shard_map: same signature, but leaves are the
+    # per-device shards and big leaves go through the single-pass Pallas
+    # kernel (optimizer/fused_kernel.py). GSPMD cannot partition a
+    # pallas_call, so the caller (make_train_step) wraps this in shard_map
+    # with the param/state PartitionSpecs.
+    update_and_params_local: Callable
 
 
 def adamw_fp32_master(
@@ -47,14 +71,19 @@ def adamw_fp32_master(
             master=master,
         )
 
-    def update_fn(updates, state, params=None):
-        if params is None:
-            raise ValueError("adamw_fp32_master requires params")
+    def _advance(updates, state, scale=None):
+        """Shared moment/master math; ``scale`` is an optional fp32 scalar
+        multiplied into the grads (the clip factor, fused — the scaled grad
+        tree is never materialized in HBM)."""
         # schedules see the pre-increment count (optax convention: first
         # update uses step 0), bias correction uses the post-increment count
         lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
         count = state.count + 1
-        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), updates)
+        if scale is None:
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), updates)
+        else:
+            s = jnp.asarray(scale, jnp.float32)
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32) * s, updates)
         mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g32)
         nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, g32)
         c = count.astype(jnp.float32)
@@ -67,7 +96,63 @@ def adamw_fp32_master(
             return master - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * master)
 
         master = jax.tree.map(step, state.master, mu, nu)
-        new_updates = jax.tree.map(lambda mst, p: mst.astype(p.dtype) - p, master, params)
-        return new_updates, FP32MasterState(count=count, mu=mu, nu=nu, master=master)
+        return FP32MasterState(count=count, mu=mu, nu=nu, master=master)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("adamw_fp32_master requires params")
+        new_state = _advance(updates, state)
+        new_updates = jax.tree.map(
+            lambda mst, p: mst.astype(p.dtype) - p, new_state.master, params)
+        return new_updates, new_state
+
+    def update_and_params_fn(updates, state, params, scale=None):
+        """Fused form: new params ARE the cast of the new master — the old
+        params are never read (``cast(master_new) - p + p == cast(master_new)``
+        exactly; the classic path's round trip is algebraically the identity
+        in the param dtype)."""
+        new_state = _advance(updates, state, scale)
+        new_params = jax.tree.map(
+            lambda mst, p: mst.astype(p.dtype), new_state.master, params)
+        return new_params, new_state
+
+    def update_and_params_local_fn(updates, state, params, scale=None):
+        """Per-device-shard update: tileable leaves run the single-pass
+        Pallas kernel (one HBM read+write of each state buffer — the
+        roofline); the rest (biases, norms — negligible bytes) take the jnp
+        path. Must run inside shard_map (see FusedGradientTransformation)."""
+        from neuronx_distributed_tpu.optimizer.fused_kernel import (
+            fused_adamw_leaf,
+            leaf_supported,
+        )
+
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        s = jnp.float32(1.0) if scale is None else jnp.asarray(scale, jnp.float32)
+        scalars = jnp.stack(
+            [s, jnp.asarray(lr, jnp.float32),
+             1.0 - b1**c, 1.0 - b2**c]).reshape(1, 4)
+
+        def leaf(g, m, v, mst, p):
+            if leaf_supported(g.size):
+                return fused_adamw_leaf(
+                    g, m, v, mst, scalars, b1=b1, b2=b2, eps=eps,
+                    wd=weight_decay, p_dtype=p.dtype)
+            g32 = g.astype(jnp.float32) * s
+            m2 = b1 * m + (1 - b1) * g32
+            v2 = b2 * v + (1 - b2) * g32 * g32
+            bc1, bc2 = scalars[0, 2], scalars[0, 3]
+            mst2 = mst - lr * ((m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+                               + weight_decay * mst)
+            return m2, v2, mst2, mst2.astype(p.dtype)
+
+        tup = jax.tree.map(leaf, updates, state.mu, state.nu, state.master, params)
+        pick = lambda i: jax.tree.map(  # noqa: E731
+            lambda t: t[i], tup, is_leaf=lambda t: isinstance(t, tuple))
+        new_state = FP32MasterState(count=count, mu=pick(0), nu=pick(1),
+                                    master=pick(2))
+        return pick(3), new_state
+
+    return FusedGradientTransformation(
+        init_fn, update_fn, update_and_params_fn, update_and_params_local_fn)

@@ -80,6 +80,126 @@ def test_plain_adamw_path():
     assert losses[-1] < losses[0]
 
 
+def test_pallas_adamw_kernel_matches_jnp():
+    """The single-pass Pallas AdamW kernel (optimizer/fused_kernel.py, run
+    under the interpreter on CPU) must reproduce the jnp update exactly:
+    same mu/nu/master math, params = cast of the new master."""
+    from neuronx_distributed_tpu.optimizer.fused_kernel import (
+        fused_adamw_leaf,
+        leaf_supported,
+    )
+
+    n = 16384
+    assert leaf_supported(n) and not leaf_supported(n - 128)
+    rs = np.random.RandomState(5)
+    g = jnp.asarray(rs.randn(n) * 2, jnp.bfloat16)
+    mu = jnp.asarray(rs.randn(n) * 0.1, jnp.float32)
+    nu = jnp.asarray(np.abs(rs.randn(n)) * 0.01, jnp.float32)
+    ms = jnp.asarray(rs.randn(n), jnp.float32)
+    b1, b2, eps, wd, lr, scl, bc1, bc2 = 0.9, 0.999, 1e-8, 0.01, 1e-2, 0.7, 0.5, 0.3
+    scalars = jnp.asarray([[scl, lr, bc1, bc2]], jnp.float32)
+    mu2, nu2, ms2, p2 = fused_adamw_leaf(
+        g, mu, nu, ms, scalars, b1=b1, b2=b2, eps=eps, wd=wd,
+        p_dtype=jnp.bfloat16)
+
+    g32 = np.asarray(g, np.float32) * scl
+    mu_ref = b1 * np.asarray(mu) + (1 - b1) * g32
+    nu_ref = b2 * np.asarray(nu) + (1 - b2) * g32 * g32
+    ms_ref = np.asarray(ms) - lr * (
+        (mu_ref / bc1) / (np.sqrt(nu_ref / bc2) + eps) + wd * np.asarray(ms))
+    np.testing.assert_allclose(np.asarray(mu2), mu_ref, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(nu2), nu_ref, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ms2), ms_ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(p2), np.asarray(
+        jnp.asarray(ms2).astype(jnp.bfloat16)))
+
+
+def test_kernel_step_matches_default_trajectory():
+    """make_train_step(optimizer_kernel=True) — the shard_map + Pallas
+    optimizer path (interpreted on CPU) — must track the default XLA-fused
+    path's loss trajectory on a TP x ZeRO-1 model."""
+    cfg = neuronx_distributed_config(
+        tensor_parallel_size=2,
+        optimizer_config={"zero_one_enabled": True, "grad_clipping": True,
+                          "max_grad_norm": 1.0},
+        mixed_precision_config={"use_master_weights": True},
+    )
+    x = np.random.RandomState(0).randn(16, 8, 32).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 8, 32).astype(np.float32)
+
+    def run(kernel):
+        if ps.model_parallel_is_initialized():
+            ps.destroy_model_parallel()
+        model = initialize_parallel_model(cfg, ParallelMLP, jnp.zeros((16, 8, 32)))
+        opt = initialize_parallel_optimizer(cfg, model, learning_rate=1e-2,
+                                            weight_decay=0.0)
+        state = create_train_state(model, opt)
+        step = make_train_step(model, opt, _loss_fn_builder(model),
+                               optimizer_kernel=kernel)
+        losses = []
+        for i in range(4):
+            state, m = step(state, {"x": x, "y": y}, jax.random.key(i))
+            losses.append(float(m["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_update_and_params_matches_classic():
+    """The fused single-pass optimizer (update_and_params: new params are
+    the cast of the new master, clip scale folded into the grad cast) must
+    track the classic updates/apply_updates path: identical master/moment
+    states, params equal to the exact cast of the master."""
+    from neuronx_distributed_tpu.optimizer.adamw import adamw_fp32_master
+    from neuronx_distributed_tpu.parallel.grads import clip_grad_norm, get_grad_norm
+
+    tx = adamw_fp32_master(1e-2, weight_decay=0.01)
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(16, 8) * 3, jnp.bfloat16),
+              "b": jnp.asarray(rs.randn(8), jnp.float32)}
+    grads = {"w": jnp.asarray(rs.randn(16, 8) * 5, jnp.bfloat16),
+             "b": jnp.asarray(rs.randn(8) * 5, jnp.float32)}
+    max_norm = 1.0
+
+    # classic: materialized clipped grads -> updates -> apply
+    s0 = tx.init(params)
+    clipped, norm = clip_grad_norm(grads, max_norm)
+    upd, s_classic = tx.update(clipped, s0, params)
+    p_classic = optax.apply_updates(params, upd)
+
+    # fused: scale folded in, params emitted directly
+    scale = jnp.clip(max_norm / (get_grad_norm(grads) + 1e-6), max=1.0)
+    p_fused, s_fused = tx.update_and_params(grads, tx.init(params), params,
+                                            scale=scale)
+
+    # moments/master agree (fused applies the clip scale in fp32 — strictly
+    # tighter than the classic bf16 round-trip of the scaled grads)
+    for k in ("mu", "nu", "master"):
+        got = jax.tree.map(np.asarray, getattr(s_fused, k))
+        want = jax.tree.map(np.asarray, getattr(s_classic, k))
+        np.testing.assert_allclose(got["w"], want["w"], rtol=1e-2, atol=1e-6)
+        np.testing.assert_allclose(got["b"], want["b"], rtol=1e-5, atol=1e-8)
+    # fused params are the EXACT cast of the fused master
+    np.testing.assert_array_equal(
+        np.asarray(p_fused["w"]),
+        np.asarray(s_fused.master["w"].astype(jnp.bfloat16)))
+    np.testing.assert_array_equal(
+        np.asarray(p_fused["b"]), np.asarray(s_fused.master["b"]))
+    # and numerically track the classic path's params
+    np.testing.assert_allclose(
+        np.asarray(p_fused["w"], np.float32),
+        np.asarray(p_classic["w"], np.float32), rtol=2e-2, atol=1e-3)
+
+    # without clipping the two paths are algebraically identical in fp32
+    upd2, s2 = tx.update(grads, tx.init(params), params)
+    p2f, s2f = tx.update_and_params(grads, tx.init(params), params)
+    for k in ("mu", "nu", "master"):
+        got = jax.tree.map(np.asarray, getattr(s2f, k))
+        want = jax.tree.map(np.asarray, getattr(s2, k))
+        np.testing.assert_array_equal(got["w"], want["w"])
+        np.testing.assert_array_equal(got["b"], want["b"])
+
+
 def test_zero1_param_spec_assignment():
     ps.initialize_model_parallel(tensor_model_parallel_size=2)  # dp=4 → edp=4
     # unsharded 2D param: first divisible dim gets the DP axes

@@ -251,20 +251,31 @@ def cmd_speculate(args) -> None:
     prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
     # warmup compiles every program (target/draft prefill+decode, proposer,
     # chunk verify) OUTSIDE the timed window — cmd_generate's discipline
-    run = lambda n, rng: speculative_generate(  # noqa: E731
+    run = lambda n, rng, stats=False: speculative_generate(  # noqa: E731
         lm, draft, prompt, max_new_tokens=n,
         num_draft=args.num_draft, greedy=not args.sample,
-        temperature=args.temperature, rng=rng,
+        temperature=args.temperature, rng=rng, collect_stats=stats,
     )
     run(2, jax.random.key(args.seed + 1))
+    # timed pass WITHOUT the per-submodel syncs (they add 2 host round-trips
+    # per round and would bias tokens_per_sec down); a second short
+    # instrumented pass supplies the draft/verify percentiles
     t0 = time.perf_counter()
     result = run(args.max_new_tokens, jax.random.key(args.seed))
     dt = time.perf_counter() - t0
+    instr = run(min(args.max_new_tokens, 16), jax.random.key(args.seed),
+                stats=True)
+    sub = {k: v for k, v in (instr.stats or {}).items()
+           if k.startswith(("draft_ms", "verify_ms"))}
     print(json.dumps({
         "generated": result.tokens[0][: int(result.lengths[0])].tolist(),
         "tokens_per_sec": round(int(result.lengths[0]) / dt, 1),
         "draft_layers": draft_cfg.num_layers,
         "num_draft": args.num_draft,
+        # acceptance + per-submodel p50/p90 (reference benchmark.py:55-71
+        # percentile report applied to the speculation submodels)
+        **(result.stats or {}),
+        **sub,
     }))
 
 

@@ -71,6 +71,16 @@ def infer_prompt_lengths(prompt_ids: np.ndarray, pad_token_id: int = 0) -> np.nd
 class GenerationResult:
     tokens: np.ndarray          # (b, max_new_tokens), eos-padded
     lengths: np.ndarray         # (b,) generated lengths incl. eos
+    # speculation paths attach per-run metrics (rounds, proposed/accepted
+    # counts, per-round wall times) — the reference benchmark's
+    # per-submodel report surface (examples/inference/runner.py:454-530)
+    stats: Optional[dict] = None
+
+
+def percentile_ms(ts, q) -> Optional[float]:
+    """q-th percentile of a list of second-timings, in ms (None when empty) —
+    the speculation paths' shared stats helper."""
+    return round(float(np.percentile(np.asarray(ts) * 1e3, q)), 2) if ts else None
 
 
 @dataclasses.dataclass

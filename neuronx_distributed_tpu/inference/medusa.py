@@ -24,6 +24,7 @@ The tree mask rides the ``chunk_ctx`` hook in the Llama attention
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,7 @@ from neuronx_distributed_tpu.inference.causal_lm import (
     GenerationResult,
     _set_cache_index,
     infer_prompt_lengths,
+    percentile_ms,
 )
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaModel
 from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
@@ -248,13 +250,21 @@ def medusa_generate(
 
     out: List[int] = []
     cur = length
+    rounds = 0
+    accepted_total = 0
+    round_times: List[float] = []
+    tree_times: List[float] = []
+    replay_times: List[float] = []
     while len(out) < max_new_tokens:
+        t_round = time.perf_counter()
         tree_tokens, candidates = generate_candidates(last_logits, last_med, buffers)
         # one cached forward verifies the whole tree (tree mask + depth RoPE);
         # nodes land on slots cur..cur+m-1 — invalidated by the rollback below
+        t_tree = time.perf_counter()
         tree_logits, cache = tree_step(params, cache,
                                        jnp.asarray(tree_tokens[None], jnp.int32))
         tl = np.asarray(tree_logits[0], np.float32)                # (m, V)
+        tree_times.append(time.perf_counter() - t_tree)
         path_argmax = np.argmax(tl[np.clip(ri, 0, None)], axis=-1)  # (paths, depth+1)
         best, acc = evaluate_posterior_greedy(path_argmax, candidates)
         accepted = [int(t) for t in candidates[best, : acc + 1]]
@@ -265,13 +275,18 @@ def medusa_generate(
         cache = _set_cache_index(cache, jnp.asarray([cur], jnp.int32))
         chunk = np.zeros((1, depth + 1), np.int32)
         chunk[0, : len(accepted)] = accepted
+        t_replay = time.perf_counter()
         logits, med, cache = replay(params, cache, jnp.asarray(chunk))
         cur += len(accepted)
         cache = _set_cache_index(cache, jnp.asarray([cur], jnp.int32))
         last_logits = np.asarray(logits[0, len(accepted) - 1], np.float32)
         last_med = np.asarray(med[:, 0, len(accepted) - 1], np.float32)
+        replay_times.append(time.perf_counter() - t_replay)
 
         out.extend(accepted)
+        rounds += 1
+        accepted_total += acc  # tokens accepted BEYOND the root per round
+        round_times.append(time.perf_counter() - t_round)
         if eos_token_id is not None and eos_token_id in accepted:
             out = out[: out.index(eos_token_id) + 1]
             break
@@ -279,4 +294,17 @@ def medusa_generate(
     out = out[:max_new_tokens]
     tokens = np.zeros((1, max_new_tokens), np.int64)
     tokens[0, : len(out)] = out
-    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32))
+    pct = percentile_ms
+    stats = {
+        "rounds": rounds,
+        "depth": depth,
+        "proposed": rounds * depth,
+        "accepted": accepted_total,
+        "acceptance_rate": round(accepted_total / max(rounds * depth, 1), 4),
+        "tokens_per_round": round(len(out) / max(rounds, 1), 2),
+        "round_ms_p50": pct(round_times, 50), "round_ms_p90": pct(round_times, 90),
+        "tree_ms_p50": pct(tree_times, 50), "tree_ms_p90": pct(tree_times, 90),
+        "replay_ms_p50": pct(replay_times, 50), "replay_ms_p90": pct(replay_times, 90),
+    }
+    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32),
+                            stats=stats)

@@ -26,6 +26,7 @@ its aliased KV buffers the same way).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -38,6 +39,7 @@ from neuronx_distributed_tpu.inference.causal_lm import (
     GenerationResult,
     _set_cache_index,
     infer_prompt_lengths,
+    percentile_ms,
 )
 
 
@@ -118,11 +120,18 @@ def speculative_generate(
     greedy: bool = True,
     temperature: float = 1.0,
     rng: Optional[jax.Array] = None,
+    collect_stats: bool = False,
 ) -> GenerationResult:
     """Assisted decoding, batch 1 (the reference's assisted loop is also
     per-sequence). ``greedy=False`` switches to sampling acceptance — the
     returned tokens are distributed exactly as target-model sampling at
-    ``temperature``. Stops at ``eos_token_id``."""
+    ``temperature``. Stops at ``eos_token_id``.
+
+    ``collect_stats`` additionally times the draft and verify submodels,
+    which costs TWO extra host syncs per round (the normal loop blocks only
+    once, at the acceptance read) — leave it off outside benchmarking.
+    Acceptance counts and per-round times ride on the existing sync and are
+    always reported in ``result.stats``."""
     if prompt_ids.shape[0] != 1:
         raise ValueError("speculative_generate handles batch size 1")
     if target._decode is None:
@@ -175,19 +184,32 @@ def speculative_generate(
 
     out: list[int] = [last_tok]
     cur_len = length
+    rounds = 0
+    accepted_total = 0
+    round_times: list[float] = []
+    draft_times: list[float] = []
+    verify_times: list[float] = []
     while len(out) < max_new_tokens and (
         eos_token_id is None or out[-1] != eos_token_id
     ):
+        t_round = time.perf_counter()
         # 1. draft proposes γ tokens in ONE device program
         rng, r_prop, r_acc = jax.random.split(rng, 3)
         last = jnp.full((b,), out[-1], jnp.int32)
         toks, probs, d_cache = proposer(draft.params, d_cache, last, r_prop)
+        if collect_stats:  # extra host sync — benchmarking only
+            jax.block_until_ready(toks)
+            draft_times.append(time.perf_counter() - t_round)
         # 2. target scores [last, p1..pγ] in one chunked forward
+        t_verify = time.perf_counter()
         chunk = jnp.concatenate(
             [jnp.full((b, 1), out[-1], jnp.int32), toks[:, 0][None, :].repeat(b, 0)],
             axis=1,
         )
         t_logits, t_cache = chunk_compiled(target.params, t_cache, chunk)
+        if collect_stats:  # extra host sync — benchmarking only
+            jax.block_until_ready(t_logits)
+            verify_times.append(time.perf_counter() - t_verify)
         # 3. acceptance math in one device call
         acc_dev, next_dev = _accept(
             t_logits[0], toks[:, 0], probs[:, 0], r_acc, greedy, temperature
@@ -218,8 +240,25 @@ def speculative_generate(
         lens[0] = cur_len
         t_cache = _set_cache_index(t_cache, jnp.asarray(lens))
         d_cache = _set_cache_index(d_cache, jnp.asarray(lens))
+        rounds += 1
+        accepted_total += accepted
+        round_times.append(time.perf_counter() - t_round)
 
     out = out[:max_new_tokens]
     tokens = np.zeros((1, max_new_tokens), np.int64)
     tokens[0, : len(out)] = out
-    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32))
+    pct = percentile_ms
+    stats = {
+        "rounds": rounds,
+        "num_draft": num_draft,
+        "proposed": rounds * num_draft,
+        "accepted": accepted_total,
+        "acceptance_rate": round(accepted_total / max(rounds * num_draft, 1), 4),
+        # each round also emits one token from the target's own distribution
+        "tokens_per_round": round(len(out) / max(rounds, 1), 2),
+        "round_ms_p50": pct(round_times, 50), "round_ms_p90": pct(round_times, 90),
+        "draft_ms_p50": pct(draft_times, 50), "draft_ms_p90": pct(draft_times, 90),
+        "verify_ms_p50": pct(verify_times, 50), "verify_ms_p90": pct(verify_times, 90),
+    }
+    return GenerationResult(tokens=tokens, lengths=np.asarray([len(out)], np.int32),
+                            stats=stats)

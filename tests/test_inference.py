@@ -136,8 +136,16 @@ def test_speculative_self_draft_matches_greedy():
     params = _params(cfg, jnp.asarray(ids))
     lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=1).compile()
     golden = lm.generate(ids, max_new_tokens=6)
-    spec = speculative_generate(lm, lm, ids, max_new_tokens=6, num_draft=3)
+    spec = speculative_generate(lm, lm, ids, max_new_tokens=6, num_draft=3,
+                                collect_stats=True)
     np.testing.assert_array_equal(spec.tokens, golden.tokens)
+    # stats surface (reference benchmark report role): self-draft greedy
+    # acceptance is exactly 1.0, and the per-submodel percentiles exist
+    assert spec.stats["acceptance_rate"] == 1.0, spec.stats
+    assert spec.stats["accepted"] == spec.stats["proposed"] > 0
+    for k in ("round_ms_p50", "draft_ms_p50", "verify_ms_p50",
+              "round_ms_p90", "draft_ms_p90", "verify_ms_p90"):
+        assert spec.stats[k] is not None and spec.stats[k] >= 0
 
 
 def test_speculative_different_draft_still_exact():

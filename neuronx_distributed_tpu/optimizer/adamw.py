@@ -11,7 +11,7 @@ master copy shards under the ZeRO-1 plan like any other state leaf.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import optax
 
-from neuronx_distributed_tpu.parallel import mesh as ps
 from neuronx_distributed_tpu.parallel.grads import clip_grad_norm
 from neuronx_distributed_tpu.trainer.model import ParallelModel
 from neuronx_distributed_tpu.trainer.optimizer import NxDOptimizer

@@ -279,6 +279,64 @@ def cmd_speculate(args) -> None:
     }))
 
 
+def cmd_medusa(args) -> None:
+    """Medusa tree decoding (reference speculative runner's medusa mode,
+    utils/speculative_decoding.py:189). Heads are RANDOMLY initialized (no
+    head-checkpoint loading is wired), so acceptance is near zero — but
+    Medusa's greedy-posterior invariant guarantees the OUTPUT equals the
+    base model's greedy continuation regardless; the per-round p50s (which
+    exclude the first round's compile) show the machinery's cost. No
+    end-to-end tok/s is reported: medusa_generate builds its programs per
+    call, so a wall-clock over the call would mostly measure compilation."""
+    import dataclasses
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.medusa import (
+        DEFAULT_CHOICES,
+        MedusaLlamaForCausalLM,
+        medusa_generate,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    if args.model != "llama":
+        raise SystemExit("medusa supports --model llama")
+    if args.hf_checkpoint or getattr(args, "quantize", False) or args.sample:
+        raise SystemExit(
+            "medusa supports none of --hf_checkpoint/--quantize/--sample "
+            "(random heads, greedy posterior)")
+    cfg = build_config(args)
+    tp = args.tensor_parallel_size or (2 if args.tiny else 8)
+    if not ps.model_parallel_is_initialized():
+        ps.initialize_model_parallel(tensor_model_parallel_size=tp)
+    mm = MedusaLlamaForCausalLM(
+        dataclasses.replace(cfg, decode=True), num_medusa_heads=2)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    mparams = meta.unbox(jax.jit(
+        lambda: mm.init(jax.random.key(args.seed), ids0))())["params"]
+    rs = np.random.RandomState(args.seed)
+    prompt_len = 16 if args.tiny else 128
+    prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
+    result = medusa_generate(
+        cfg, mparams, prompt, max_new_tokens=args.max_new_tokens,
+        num_medusa_heads=2, medusa_choices=DEFAULT_CHOICES)
+
+    # invariant check: output == the base model's greedy continuation
+    base_params = {k: v for k, v in mparams.items() if not k.startswith("medusa")}
+    lm = CausalLM(cfg, base_params, _model_cls(args),
+                  buckets=(prompt_len,), max_batch=1)
+    golden = lm.generate(prompt, max_new_tokens=args.max_new_tokens)
+    n = int(result.lengths[0])
+    exact = bool(np.array_equal(result.tokens[0][:n], golden.tokens[0][:n]))
+    print(json.dumps({
+        "generated": result.tokens[0][:n].tolist(),
+        "matches_base_greedy": exact,
+        **(result.stats or {}),
+    }))
+    if not exact:
+        raise SystemExit(1)
+
+
 def cmd_check_accuracy(args) -> None:
     """Correctness gate (reference runner.py ``check_accuracy``:290 +
     ``check_accuracy_logits``:352): the SERVING stack's greedy continuation
@@ -378,7 +436,7 @@ def cmd_check_accuracy(args) -> None:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("generate", "benchmark", "speculate", "check-accuracy"):
+    for name in ("generate", "benchmark", "speculate", "medusa", "check-accuracy"):
         p = sub.add_parser(name)
         p.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
         p.add_argument("--tiny", action="store_true")
@@ -407,7 +465,8 @@ def main(argv=None) -> None:
 
         force_cpu_mesh()
     {"generate": cmd_generate, "benchmark": cmd_benchmark,
-     "speculate": cmd_speculate, "check-accuracy": cmd_check_accuracy}[args.cmd](args)
+     "speculate": cmd_speculate, "medusa": cmd_medusa,
+     "check-accuracy": cmd_check_accuracy}[args.cmd](args)
 
 
 if __name__ == "__main__":

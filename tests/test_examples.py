@@ -155,6 +155,19 @@ def test_inference_runner_speculate_tiny(capsys):
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert len(report["generated"]) == 6
     assert report["draft_layers"] == 1
+    # benchmark surface: acceptance + submodel percentiles present
+    assert 0.0 <= report["acceptance_rate"] <= 1.0
+    assert report["draft_ms_p50"] is not None
+
+
+def test_inference_runner_medusa_tiny(capsys):
+    import runner
+
+    runner.main(["medusa", "--tiny", "--max_new_tokens", "6"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(report["generated"]) == 6
+    assert report["matches_base_greedy"] is True  # the medusa invariant
+    assert report["tree_ms_p50"] is not None
 
 
 def test_inference_runner_mixtral_tiny(capsys):

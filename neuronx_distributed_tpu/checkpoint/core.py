@@ -13,6 +13,10 @@ Same crash-safety protocol as the reference:
 * async save snapshots to host memory synchronously (donation-safe: the train
   step may overwrite device buffers immediately) and writes on a 1-worker
   thread, flushed at exit (reference's ThreadPool + atexit, :644-647).
+  Multi-host async rides orbax's AsyncCheckpointer (per-host addressable
+  shards copied device->host before returning) with the barrier protocol's
+  agreement running over the TCP coordination service — thread-safe, so the
+  done marker is published from the worker once EVERY host's write landed.
 
 Tensor IO is orbax/tensorstore — each host writes its addressable shards of
 the global arrays (the TPU-native equivalent of the reference's per-rank
@@ -25,6 +29,7 @@ resharding tools for the common cases.
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import logging
 import threading
@@ -52,6 +57,61 @@ _PAYLOAD_DIR = "state"
 _executor: Optional[ThreadPoolExecutor] = None
 _pending: list = []
 _lock = threading.Lock()
+
+_BARRIER_TIMEOUT_MS = 1_800_000  # end barrier spans the slowest host's write
+_barrier_seq = itertools.count()
+
+
+def _agree_all_ok(ok: bool, name: str) -> bool:
+    """Barrier that also AGREES on success: every host reaches it even if its
+    local work failed (no stragglers stuck in a collective — the deadlock
+    mode of a bare barrier after a raising section), and the checkpoint only
+    proceeds/completes if EVERY host succeeded.
+
+    Uses the TCP coordination service when available — thread-safe, so it
+    may run on the checkpoint worker thread (device collectives issued from
+    a background thread would race the training program on the same
+    devices). Barrier ids are sequence-numbered; SPMD discipline (every
+    process performs the same checkpoint calls in the same order) keeps the
+    sequences aligned across hosts. Falls back to a device all-gather on
+    runtimes without a coordination client (main-thread sync saves only).
+    """
+    n = jax.process_count()
+    if n == 1:
+        return ok
+    client = _coordination_client()
+    if client is not None:
+        key = f"nxd_ckpt/{next(_barrier_seq)}/{name}"
+        client.key_value_set(f"{key}/{jax.process_index()}", "1" if ok else "0")
+        client.wait_at_barrier(f"{key}/barrier", _BARRIER_TIMEOUT_MS)
+        vals = [client.blocking_key_value_get(f"{key}/{i}", _BARRIER_TIMEOUT_MS)
+                for i in range(n)]
+        # clean up this round's keys (a long run would otherwise grow the
+        # coordination service unboundedly); the second barrier orders the
+        # delete after every host's reads
+        try:
+            client.wait_at_barrier(f"{key}/read", _BARRIER_TIMEOUT_MS)
+            if jax.process_index() == 0:
+                client.key_value_delete(f"{key}/")
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
+        return all(v == "1" for v in vals)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(jnp.asarray([1.0 if ok else 0.0]))
+    return bool(np.asarray(flags).min() >= 1.0)
+
+
+def _coordination_client():
+    """The TCP coordination-service client, or None (internal API — the
+    multi-host async path requires it so its barriers never fall back to
+    device collectives on the worker thread)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client
+    except Exception:  # noqa: BLE001 — internal API may move across versions
+        return None
 
 
 def _get_executor() -> ThreadPoolExecutor:
@@ -119,24 +179,6 @@ def save_checkpoint(
     """
     storage = create_checkpoint_storage(checkpoint_dir)
 
-    # synchronous host snapshot (donation-safe: the train step may overwrite
-    # device buffers the moment we return). Multi-host arrays that span
-    # non-addressable devices stay as jax.Arrays — orbax/tensorstore writes
-    # each host's addressable shards (no full gather is possible there).
-    has_remote = False
-
-    def snap(x):
-        nonlocal has_remote
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            # cannot host-gather a multi-host array; the write must happen
-            # BEFORE the caller's next (donating) step, so async degrades to
-            # sync below
-            has_remote = True
-            return x
-        return np.asarray(x)
-
-    snapshot = jax.tree.map(snap, state)
-
     # Multi-host protocol (reference rendezvouses around checkpoint IO,
     # trainer/checkpoint.py:131,178-182): process 0 owns every control-plane
     # write (cleanup, markers, retention); barriers fence payload writes so
@@ -144,27 +186,32 @@ def save_checkpoint(
     # (b) the done marker only appears after EVERY host finished its shards.
     n_procs = jax.process_count()
     is_p0 = jax.process_index() == 0
+    multi_host_async = async_save and n_procs > 1
+    if multi_host_async and _coordination_client() is None:
+        # without the TCP coordination service the completion barriers would
+        # fall back to device collectives — unsafe from the worker thread
+        # while the main thread runs donated train steps on the same devices
+        logger.warning("async_save downgraded to sync: no coordination "
+                       "service client for thread-safe barriers")
+        async_save = False
+        multi_host_async = False
 
-    def all_ok(ok: bool, name: str) -> bool:
-        """Barrier that also AGREES on success: every host reaches it even if
-        its local work failed (no stragglers stuck in a collective — the
-        deadlock mode of a bare barrier after a raising section), and the
-        checkpoint only proceeds/completes if EVERY host succeeded."""
-        if n_procs == 1:
-            return ok
-        from jax.experimental import multihost_utils
+    # snapshot (donation safety: the train step may overwrite device buffers
+    # the moment we return). Sync/single-host paths host-copy addressable
+    # leaves here; multi-host arrays spanning non-addressable devices stay as
+    # jax.Arrays — orbax/tensorstore writes each host's addressable shards
+    # (no full gather is possible there). The multi-host ASYNC path hands the
+    # ORIGINAL tree to orbax's AsyncCheckpointer, whose save() copies this
+    # host's addressable shards to host memory before returning.
+    def snap(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x
+        return np.asarray(x)
 
-        flags = multihost_utils.process_allgather(
-            jnp.asarray([1.0 if ok else 0.0]))
-        return bool(np.asarray(flags).min() >= 1.0)
+    snapshot = state if multi_host_async else jax.tree.map(snap, state)
+    path = storage.abspath(f"{tag}/{_PAYLOAD_DIR}")
 
-    def write():
-        # ALL control-plane work happens here: with async saves the 1-worker
-        # executor serializes cleanup/markers/writes/retention, so a pending
-        # younger save can never be mistaken for an interrupted one (the race
-        # class the reference fences with rendezvous, checkpoint.py:274-280)
-        import orbax.checkpoint as ocp
-
+    def begin():
         err: Optional[Exception] = None
         if is_p0:
             try:
@@ -182,19 +229,14 @@ def save_checkpoint(
                 storage.remove_file(f"{tag}/{_DONE_MARKER}")
             except Exception as e:  # noqa: BLE001 — must still reach the barrier
                 err = e
-        if not all_ok(err is None, "begin"):
+        if not _agree_all_ok(err is None, "begin"):
             raise RuntimeError(f"checkpoint {tag!r}: control-plane begin failed") from err
 
-        try:
-            path = storage.abspath(f"{tag}/{_PAYLOAD_DIR}")
-            with ocp.PyTreeCheckpointer() as ckptr:
-                ckptr.save(path, snapshot, force=True)
-        except Exception as e:  # noqa: BLE001 — must still reach the barrier
-            err = e
+    def finish(err: Optional[Exception]):
         # every host's shards durable before the completion marker; if ANY
         # host failed, no done marker — the tag stays "interrupted" and the
         # next save cleans it up
-        if not all_ok(err is None, "end"):
+        if not _agree_all_ok(err is None, "end"):
             raise RuntimeError(f"checkpoint {tag!r}: payload write failed") from err
         if is_p0:
             # completion sequence continues across restarts: next = max+1
@@ -219,19 +261,64 @@ def save_checkpoint(
                     storage.remove_file(f"{old}/{_DONE_MARKER}")
                     storage.remove_dir(old)
 
-    if has_remote and async_save:
-        logger.warning(
-            "async_save downgraded to sync: state contains multi-host arrays "
-            "whose device buffers cannot be host-snapshotted (donation safety)"
-        )
-        async_save = False
-    if n_procs > 1 and async_save:
-        # the barriers are device collectives; issuing them from the
-        # background worker would race the training program on the same
-        # devices (the reference's async path rendezvouses on the main
-        # thread for the same reason)
-        logger.warning("async_save downgraded to sync in multi-host mode")
-        async_save = False
+    if multi_host_async:
+        # True multi-host async (the barriers are TCP coordination-service
+        # ops, so the completion tail is thread-safe on the worker):
+        # 1. serialize behind pending saves (an older tail may still be
+        #    writing; begin's interrupted-tag cleanup must not see it as
+        #    stale) and run the control-plane begin — this blocks only when
+        #    saves are issued back-to-back;
+        # 2. AsyncCheckpointer.save on THIS thread copies the addressable
+        #    shards device->host before returning (donation-safe), then
+        #    writes + orbax's own commit coordination run in its background;
+        # 3. the worker tail waits for every host's write, agrees on
+        #    success, and lets p0 publish the done marker + retention.
+        import orbax.checkpoint as ocp
+
+        _get_executor().submit(begin).result()
+        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        save_err: Optional[Exception] = None
+        try:
+            ckptr.save(path, snapshot, force=True)
+        except Exception as e:  # noqa: BLE001 — the tail MUST still reach the
+            # end barrier: a host skipping it would strand the others for the
+            # full timeout AND desync the barrier sequence for every later save
+            save_err = e
+
+        def tail():
+            err = save_err
+            if err is None:
+                try:
+                    ckptr.wait_until_finished()
+                except Exception as e:  # noqa: BLE001 — must reach the barrier
+                    err = e
+            try:
+                ckptr.close()
+            except Exception:  # noqa: BLE001 — close is best-effort
+                pass
+            finish(err)
+
+        fut = _get_executor().submit(tail)
+        with _lock:
+            _pending.append(fut)
+        return
+
+    def write():
+        # ALL control-plane work happens here: with async saves the 1-worker
+        # executor serializes cleanup/markers/writes/retention, so a pending
+        # younger save can never be mistaken for an interrupted one (the race
+        # class the reference fences with rendezvous, checkpoint.py:274-280)
+        import orbax.checkpoint as ocp
+
+        begin()
+        err: Optional[Exception] = None
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(path, snapshot, force=True)
+        except Exception as e:  # noqa: BLE001 — must still reach the barrier
+            err = e
+        finish(err)
+
     # BOTH paths go through the 1-worker executor so cleanup/markers/retention
     # are serialized against any pending async save; sync just blocks on it
     fut = _get_executor().submit(write)

@@ -79,7 +79,22 @@ def _agree_all_ok(ok: bool, name: str) -> bool:
     n = jax.process_count()
     if n == 1:
         return ok
-    client = _coordination_client()
+    # Path choice must be UNIFORM across hosts: a host on the TCP path and a
+    # host on the device path wait on different barriers and deadlock. Route
+    # through the agreed presence value, not the local client check.
+    if _async_mode_agreed():
+        client = _coordination_client()
+        if client is None:
+            # agreed-True means every peer waits on TCP barriers; silently
+            # switching this host to the device path would deadlock them all
+            # for the full timeout (e.g. jax.distributed.shutdown() before
+            # finalize_checkpoint() drained the async tail). Fail fast.
+            raise RuntimeError(
+                "coordination-service client disappeared mid-run (was "
+                "jax.distributed.shutdown() called before "
+                "finalize_checkpoint()?)")
+    else:
+        client = None
     if client is not None:
         key = f"nxd_ckpt/{next(_barrier_seq)}/{name}"
         client.key_value_set(f"{key}/{jax.process_index()}", "1" if ok else "0")
@@ -96,6 +111,13 @@ def _agree_all_ok(ok: bool, name: str) -> bool:
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
         return all(v == "1" for v in vals)
+    return _device_agree(ok)
+
+
+def _device_agree(ok: bool) -> bool:
+    """All-hosts AND of ``ok`` via a device all-gather — main-thread only
+    (a device collective from the checkpoint worker would race the training
+    program on the same devices)."""
     from jax.experimental import multihost_utils
 
     flags = multihost_utils.process_allgather(jnp.asarray([1.0 if ok else 0.0]))
@@ -112,6 +134,23 @@ def _coordination_client():
         return _jd.global_state.client
     except Exception:  # noqa: BLE001 — internal API may move across versions
         return None
+
+
+_async_mode: Optional[bool] = None
+
+
+def _async_mode_agreed() -> bool:
+    """Whether EVERY host has the TCP coordination-service client the
+    multi-host async path's worker-thread barriers require. Client presence
+    could differ across hosts (version skew of the private API), and a mixed
+    decision would pair TCP barriers with device barriers — a hang until the
+    barrier timeout. Agreed once via a main-thread device all-gather (always
+    available here) and cached: presence is fixed for the process lifetime,
+    so later saves must not re-pay a cross-host sync in the training loop."""
+    global _async_mode
+    if _async_mode is None:
+        _async_mode = _device_agree(_coordination_client() is not None)
+    return _async_mode
 
 
 def _get_executor() -> ThreadPoolExecutor:
@@ -187,10 +226,10 @@ def save_checkpoint(
     n_procs = jax.process_count()
     is_p0 = jax.process_index() == 0
     multi_host_async = async_save and n_procs > 1
-    if multi_host_async and _coordination_client() is None:
-        # without the TCP coordination service the completion barriers would
-        # fall back to device collectives — unsafe from the worker thread
-        # while the main thread runs donated train steps on the same devices
+    if multi_host_async and not _async_mode_agreed():
+        # without the TCP coordination service on EVERY host the completion
+        # barriers would fall back to device collectives — unsafe from the
+        # worker thread while the main thread runs donated train steps
         logger.warning("async_save downgraded to sync: no coordination "
                        "service client for thread-safe barriers")
         async_save = False
@@ -238,28 +277,50 @@ def save_checkpoint(
         # next save cleans it up
         if not _agree_all_ok(err is None, "end"):
             raise RuntimeError(f"checkpoint {tag!r}: payload write failed") from err
+        pub_err: Optional[Exception] = None
         if is_p0:
-            # completion sequence continues across restarts: next = max+1
-            seq = 0
-            for t in _tags_with_state(storage)[1]:
+            try:
+                # completion sequence continues across restarts: next = max+1
+                seq = 0
+                for t in _tags_with_state(storage)[1]:
+                    try:
+                        seq = max(seq, int(float(storage.load_text(f"{t}/{_DONE_MARKER}"))))
+                    except ValueError:
+                        pass
+                seq += 1
+                if user_content is not None:
+                    storage.save_text(json.dumps(user_content), f"{tag}/{_USER_CONTENT}")
+                storage.save_text(str(seq), f"{tag}/{_DONE_MARKER}")
+            except Exception as e:  # noqa: BLE001 — must still reach the barrier
+                pub_err = e
+            # retention AFTER completion (reference removes done first
+            # :233-242). A retention failure must NOT fail the save: the new
+            # checkpoint is already durably published — crashing every host
+            # over an old tag's cleanup error would turn a complete save
+            # into a job failure.
+            if pub_err is None and num_kept is not None and num_kept > 0:
                 try:
-                    seq = max(seq, int(float(storage.load_text(f"{t}/{_DONE_MARKER}"))))
-                except ValueError:
-                    pass
-            seq += 1
-            if user_content is not None:
-                storage.save_text(json.dumps(user_content), f"{tag}/{_USER_CONTENT}")
-            storage.save_text(str(seq), f"{tag}/{_DONE_MARKER}")
-            # retention AFTER completion (reference removes done first :233-242)
-            if num_kept is not None and num_kept > 0:
-                _, done_now = _tags_with_state(storage)
-                order = sorted(
-                    done_now,
-                    key=lambda t: float(storage.load_text(f"{t}/{_DONE_MARKER}")),
-                )
-                for old in order[:-num_kept]:
-                    storage.remove_file(f"{old}/{_DONE_MARKER}")
-                    storage.remove_dir(old)
+                    _, done_now = _tags_with_state(storage)
+                    order = sorted(
+                        done_now,
+                        key=lambda t: float(storage.load_text(f"{t}/{_DONE_MARKER}")),
+                    )
+                    for old in order[:-num_kept]:
+                        storage.remove_file(f"{old}/{_DONE_MARKER}")
+                        storage.remove_dir(old)
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    logger.warning("checkpoint retention cleanup failed for "
+                                   "%r; continuing (save is complete)", tag,
+                                   exc_info=True)
+        # fence the publish: every host observes the completed tag (and the
+        # retention deletes) before save/finalize returns, so a non-p0 host's
+        # immediate latest_tag/load_checkpoint sees THIS tag, not the previous
+        # one (reference rendezvouses after the done marker, checkpoint.py:182,
+        # and after removals, :255-280)
+        if not _agree_all_ok(pub_err is None, "published"):
+            raise RuntimeError(
+                f"checkpoint {tag!r}: completion publish failed"
+            ) from pub_err
 
     if multi_host_async:
         # True multi-host async (the barriers are TCP coordination-service

@@ -159,6 +159,7 @@ def cmd_generate(args) -> None:
                         top_k=args.top_k or None,
                         top_p=args.top_p if args.top_p < 1.0 else None),
         rng=jax.random.key(args.seed),
+        fused_chunk=args.fused_chunk,
     )
     for i, (toks, n) in enumerate(zip(result.tokens, result.lengths)):
         print(json.dumps({"prompt": i, "generated": toks[:n].tolist()}))
@@ -213,6 +214,24 @@ def cmd_benchmark(args) -> None:
         "token_generation": percentiles(decode),
         "decode_tokens_per_sec": round(lm.max_batch / float(np.median(decode)), 1),
     }
+
+    if args.fused_chunk > 1:
+        # fused K-step greedy decode (one program per K tokens): the serving
+        # fast path; report per-token time on the same percentile surface
+        fused = lm.compile_decode_fused(args.fused_chunk)
+        _, cache = lm._prefill[bucket](lm.params, jnp.asarray(prompt))
+        toks, cache, tok = fused(lm.params, cache, tok)
+        jax.block_until_ready(toks)
+        fused_ts = []
+        for _ in range(max(1, args.decode_steps // args.fused_chunk)):
+            t0 = time.perf_counter()
+            toks, cache, tok = fused(lm.params, cache, tok)
+            int(np.asarray(toks)[-1, 0])
+            fused_ts.append((time.perf_counter() - t0) / args.fused_chunk)
+        report["token_generation_fused"] = percentiles(fused_ts)
+        report["fused_chunk"] = args.fused_chunk
+        report["decode_tokens_per_sec_fused"] = round(
+            lm.max_batch / float(np.median(fused_ts)), 1)
     print(json.dumps(report))
 
 
@@ -454,6 +473,9 @@ def main(argv=None) -> None:
         p.add_argument("--top_p", type=float, default=1.0)
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--num_draft", type=int, default=4)
+        p.add_argument("--fused_chunk", type=int, default=0,
+                       help="K>1: greedy decode in K-step fused device "
+                            "programs (one dispatch per K tokens)")
         p.add_argument("--draft_layers", type=int, default=None)
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")

@@ -128,6 +128,7 @@ class CausalLM:
         self.model = model_cls(self.config)
         self._prefill = {}
         self._decode = None
+        self._decode_fused = {}
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
@@ -161,6 +162,63 @@ class CausalLM:
             jax.jit(decode_fn, donate_argnums=(1,)).lower(self.params, cache0, tok).compile()
         )
         return self
+
+    def compile_decode_fused(self, steps: int):
+        """Compile ``steps`` greedy decode iterations as ONE device program
+        (``lax.scan`` over the single-token step, cache donated through).
+
+        Rationale: step decode pays one program dispatch per token; at small
+        per-layer cost that fixed dispatch dominates (the ~5 ms/token decode
+        intercept attributed in PROFILE.md's r5 study). Fusing K steps
+        amortizes it K-fold. Greedy-only: the argmax feed-forward lives
+        inside the scan, so sampling params cannot vary per token. The param
+        transform (e.g. int8 dequant) is applied INSIDE the scan body —
+        quantized weights stay in HBM and XLA fuses the dequant into each
+        step's matmuls, exactly like the single-step program.
+
+        Returns the compiled program
+        ``(params, cache, tok (b,1)) -> (tokens (steps, b), cache, next_tok)``
+        where ``tokens[i]`` is the token sampled at iteration ``i`` and
+        ``next_tok`` feeds a follow-up call. Cached per ``steps``.
+
+        Reference counterpart: the token-generation submodel of the CTX/TKG
+        split (examples/inference/modules/model_base.py) — one traced
+        program per generated token; the fused loop is the TPU-native
+        improvement XLA's static control flow makes free.
+        """
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if steps in self._decode_fused:
+            return self._decode_fused[steps]
+
+        def fused_fn(params, cache, tok):
+            def body(carry, _):
+                cache, tok = carry
+                logits, mut = self.model.apply(
+                    {"params": self._resolve(params), "cache": cache}, tok,
+                    mutable=["cache"]
+                )
+                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+                return (mut["cache"], nxt[:, None]), nxt
+
+            (cache, tok), toks = jax.lax.scan(
+                body, (cache, tok), None, length=steps)
+            return toks, cache, tok
+
+        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+
+        def prefill_shape(params, ids):
+            _, mut = self.model.apply({"params": self._resolve(params)}, ids,
+                                      mutable=["cache"])
+            return mut["cache"]
+
+        cache0 = jax.eval_shape(prefill_shape, self.params, ids0)
+        tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
+        self._decode_fused[steps] = (
+            jax.jit(fused_fn, donate_argnums=(1,))
+            .lower(self.params, cache0, tok0).compile()
+        )
+        return self._decode_fused[steps]
 
     def _bucket_for(self, s: int) -> int:
         for b in self.buckets:
@@ -286,15 +344,27 @@ class CausalLM:
         rng: Optional[jax.Array] = None,
         lengths: Optional[np.ndarray] = None,
         pad_token_id: int = 0,
+        fused_chunk: int = 0,
     ) -> GenerationResult:
         """Batched generate (reference runner.generate / benchmark path).
         ``prompt_ids``: (b, s) right-padded with ``pad_token_id``. Pass
         explicit per-prompt ``lengths`` when the pad id can legitimately
         appear inside a prompt — otherwise lengths are inferred from the
-        rightmost non-pad position."""
+        rightmost non-pad position.
+
+        ``fused_chunk > 1`` decodes in K-token fused device programs
+        (``compile_decode_fused``): one dispatch + host read per K tokens
+        instead of per token. Greedy samplers only (the argmax feed-forward
+        lives inside the scan); EOS is honored at chunk granularity — the
+        device may compute (never return) up to K-1 tokens past a row's
+        EOS, exactly like the step path keeps decoding rows that finished
+        before the whole batch did."""
         if self._decode is None:
             self.compile()
         sampler = sampler or Sampler(greedy=True)
+        use_fused = fused_chunk and fused_chunk > 1
+        if use_fused and not (sampler.greedy or sampler.temperature == 0.0):
+            raise ValueError("fused_chunk requires a greedy sampler")
         rng = rng if rng is not None else jax.random.key(0)
         b, s = prompt_ids.shape
         if b > self.max_batch:
@@ -326,18 +396,38 @@ class CausalLM:
         done = np.zeros((self.max_batch,), bool)
         done[b:] = True
         gen_len = np.zeros((self.max_batch,), np.int32)
-        for t in range(max_new_tokens):
-            rng, sub = jax.random.split(rng)
-            tok = sampler(step_logits, sub)                       # (max_batch,)
-            tok_np = np.asarray(tok)
+        if max_new_tokens == 0:
+            return GenerationResult(tokens=out[:b], lengths=gen_len[:b])
+
+        def record(tok_np: np.ndarray, t: int) -> bool:
+            nonlocal done, gen_len
             out[:, t] = np.where(done, 0, tok_np)
             gen_len = np.where(done, gen_len, gen_len + 1)
             if eos_token_id is not None:
                 done = done | (tok_np == eos_token_id)
-            if done.all() or t == max_new_tokens - 1:
-                break  # the last sampled token needs no further forward
+            return bool(done.all())
+
+        rng, sub = jax.random.split(rng)
+        tok_np = np.asarray(sampler(step_logits, sub))            # (max_batch,)
+        finished = record(tok_np, 0)
+        t = 1
+        while t < max_new_tokens and not finished:
+            if use_fused and max_new_tokens - t >= fused_chunk:
+                fused = self.compile_decode_fused(fused_chunk)
+                toks, cache, _ = fused(
+                    self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32))
+                for row in np.asarray(toks):                      # (K, max_batch)
+                    tok_np = row
+                    finished = record(tok_np, t)
+                    t += 1
+                    if finished:
+                        break
+                continue
+            rng, sub = jax.random.split(rng)
             step_logits, cache = self._decode(
                 self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32)
             )
-            step_logits = step_logits[:, 0]
+            tok_np = np.asarray(sampler(step_logits[:, 0], sub))
+            finished = record(tok_np, t)
+            t += 1
         return GenerationResult(tokens=out[:b], lengths=gen_len[:b])

@@ -14,6 +14,12 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
                      tp: int = 2, trials: int = 5):
     """Single-chip-scaled CP-vs-SP attention microbench (VERDICT r2 weak #3).
 
+    THE one CP measurement basis (VERDICT r4 next #7): ``bench.py`` and
+    ``scripts/validate_long_seq.py --cp`` both call this function, and the
+    SP/CP timings are INTERLEAVED (sp,cp alternating per trial) — r4's
+    sequential blocks let machine drift between the two sides produce two
+    committed artifacts 8% apart for the same ratio.
+
     Equal global tokens, equal chip count, real kernels: the SP+flash chip
     runs causal flash over the full ``seq`` with ``heads/tp`` heads; the
     CP chip runs ``cp`` ring steps over ``seq/cp`` local tokens with all
@@ -22,9 +28,13 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
     backward through the same kernel entry points (`flash_block_forward` /
     `flash_block_grads`) jitted on the real chip, min over ``trials``.
 
-    Excluded: the ring's ppermute. Per step each chip sends its compact K/V
-    block (2*hk*s_loc*d*2 bytes bf16) over ICI concurrently with the
-    step's compute — reported as ``ici_bytes_per_step`` for context.
+    Ring-ppermute basis, stated: ``cp_vs_sp_throughput`` EXCLUDES the ring's
+    K/V transfer — the full-overlap bound, sound because the zigzag ring
+    overlaps each step's transfer with that step's compute and the transfer
+    is the smaller term (``ici_ms_per_step_modeled`` vs the per-step compute
+    ``cp_chip_ms/cp``). ``cp_vs_sp_throughput_ici_serial`` adds the modeled
+    transfer FULLY serialized ((cp-1) sends at ``ICI_BW``) — the no-overlap
+    worst case. The true multi-chip ratio lies between the two bounds.
     """
     import jax
     import jax.numpy as jnp
@@ -51,16 +61,6 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
                          f"(s_loc={s_loc} vs {(bq, bk)}, seq vs {(sbq_, sbk_)})")
     sm = 1.0 / head_dim ** 0.5
 
-    def timeit(fn, *args):
-        out = jax.block_until_ready(fn(*args))  # compile
-        ts = []
-        for _ in range(trials):
-            t0 = time.perf_counter()
-            out = jax.block_until_ready(fn(*args))
-            ts.append(time.perf_counter() - t0)
-        del out
-        return min(ts)
-
     key = jax.random.PRNGKey(0)
 
     # ---- SP side: full-seq causal flash, heads/tp per chip ---------------
@@ -78,8 +78,6 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
                                        sm, sbq, sbk, 1, h_sp)
         return jnp.sum(o.astype(jnp.float32)) + jnp.sum(dq.astype(jnp.float32)) \
             + jnp.sum(dk.astype(jnp.float32)) + jnp.sum(dv.astype(jnp.float32))
-
-    t_sp = timeit(sp_step, q, q, q, q)
 
     # ---- CP side: rank 0's zigzag ring steps, all heads ------------------
     qc = jax.random.normal(key, (heads, s_loc, head_dim), jnp.bfloat16)
@@ -114,12 +112,35 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
                 + jnp.sum(dk_i.astype(jnp.float32)) + jnp.sum(dv_i.astype(jnp.float32))
         return tot
 
-    t_cp = timeit(cp_step, qc, qc, qc, qc)
+    # compile both sides, then INTERLEAVE the timed trials (sp, cp, sp, cp,
+    # ...) so machine drift hits both sides alike instead of biasing the
+    # ratio; min per side (additive-noise estimator)
+    jax.block_until_ready(sp_step(q, q, q, q))
+    jax.block_until_ready(cp_step(qc, qc, qc, qc))
+    ts_sp, ts_cp = [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(sp_step(q, q, q, q))
+        ts_sp.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(cp_step(qc, qc, qc, qc))
+        ts_cp.append(time.perf_counter() - t0)
+    t_sp, t_cp = min(ts_sp), min(ts_cp)
+
+    ici_bytes = 2 * heads * s_loc * head_dim * 2
+    ICI_BW = 4.5e10  # B/s per v5e ICI link direction (order-of-magnitude model)
+    ici_ms = ici_bytes / ICI_BW * 1e3
+    t_cp_serial = t_cp + (cp - 1) * ici_ms / 1e3
     return {
         "seq": seq, "cp": cp, "layout": "zigzag",
         "sp_chip_ms": round(t_sp * 1e3, 2),
         "cp_chip_ms": round(t_cp * 1e3, 2),
         "cp_vs_sp_throughput": round(t_sp / t_cp, 3),
-        "ici_bytes_per_step": 2 * heads * s_loc * head_dim * 2,
-        "note": "single-chip-scaled, ppermute excluded (see docstring)",
+        "cp_vs_sp_throughput_ici_serial": round(t_sp / t_cp_serial, 3),
+        "ici_bytes_per_step": ici_bytes,
+        "ici_ms_per_step_modeled": round(ici_ms, 3),
+        "note": ("single-chip-scaled, interleaved sp/cp trials; "
+                 "cp_vs_sp_throughput excludes ring ppermute (full-overlap "
+                 "bound), *_ici_serial adds it fully serialized at 45 GB/s "
+                 "(see docstring)"),
     }

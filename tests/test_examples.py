@@ -116,6 +116,25 @@ def test_inference_runner_generate_tiny(capsys):
     assert len(lines) >= 1 and len(lines[0]["generated"]) == 4
 
 
+def test_inference_runner_benchmark_fused(capsys):
+    """--fused_chunk: the K-step fused decode rides the benchmark surface
+    and its generate output stays identical to step decode."""
+    import runner
+
+    runner.main(["benchmark", "--tiny", "--trials", "2", "--decode_steps", "4",
+                 "--fused_chunk", "2"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["fused_chunk"] == 2
+    assert report["token_generation_fused"]["p50_ms"] > 0
+
+    runner.main(["generate", "--tiny", "--max_new_tokens", "6"])
+    step_out = capsys.readouterr().out
+    runner.main(["generate", "--tiny", "--max_new_tokens", "6",
+                 "--fused_chunk", "3"])
+    fused_out = capsys.readouterr().out
+    assert step_out == fused_out
+
+
 def test_mixtral_moe_tiny():
     import mixtral_moe
 

@@ -521,3 +521,39 @@ def test_moe_selective_decode_matches_all_experts():
         out = lm.generate(ids, max_new_tokens=10)
         toks[name] = np.asarray(out.tokens[0][: int(out.lengths[0])])
     np.testing.assert_array_equal(toks["selective"], toks["all_experts"])
+
+
+def test_fused_decode_matches_stepwise():
+    """fused_chunk generation (K decode steps scanned into one device
+    program, compile_decode_fused) must emit EXACTLY the step-decode greedy
+    tokens — including a chunk tail that falls back to step decode and a
+    padded multi-row batch."""
+    cfg = LlamaConfig(**TINY)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(4), (2, 8), 1, 127))
+    params = _params(cfg, jnp.asarray(ids))
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=2).compile()
+    ref = lm.generate(ids, max_new_tokens=10)
+    for chunk in (3, 4, 16):  # tail, divides, larger-than-run
+        got = lm.generate(ids, max_new_tokens=10, fused_chunk=chunk)
+        np.testing.assert_array_equal(got.tokens, ref.tokens,
+                                      err_msg=f"fused_chunk={chunk}")
+        np.testing.assert_array_equal(got.lengths, ref.lengths)
+
+
+def test_fused_decode_eos_and_sampler_guard():
+    cfg = LlamaConfig(**TINY)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(5), (1, 8), 1, 127))
+    params = _params(cfg, jnp.asarray(ids))
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=1).compile()
+    ref = lm.generate(ids, max_new_tokens=12)
+    # pick the 3rd greedy token as "eos": both paths must stop there
+    eos = int(ref.tokens[0, 2])
+    r_step = lm.generate(ids, max_new_tokens=12, eos_token_id=eos)
+    r_fused = lm.generate(ids, max_new_tokens=12, eos_token_id=eos, fused_chunk=4)
+    np.testing.assert_array_equal(r_fused.tokens, r_step.tokens)
+    np.testing.assert_array_equal(r_fused.lengths, r_step.lengths)
+    with pytest.raises(ValueError, match="greedy"):
+        lm.generate(ids, max_new_tokens=4, fused_chunk=4,
+                    sampler=Sampler(temperature=0.7))
+    with pytest.raises(ValueError, match="steps"):
+        lm.compile_decode_fused(0)

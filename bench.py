@@ -860,7 +860,18 @@ def main():
     # roofline, PROFILE.md ceiling argument) — report both, flag the
     # discrepancy, let the reader pick the basis.
     cons = {L: t for L, t in times.items() if L >= 1}
-    t_cons, _ = _depth_fit(cons, FULL_LAYERS) if len(cons) >= 2 else (None, None)
+    t_cons = a1_cons = None
+    if len(cons) >= 2:
+        # one polyfit feeds BOTH the conservative projection and the
+        # L0-deviation gate below — _depth_fit's degenerate fallback would
+        # otherwise let the note describe a line the keys didn't use
+        xs1 = np.asarray(sorted(cons), np.float64)
+        ys1 = np.asarray([cons[int(x)] for x in xs1])
+        b1, a1_cons = np.polyfit(xs1, ys1, 1)
+        if b1 > 0 and a1_cons >= 0:
+            t_cons = float(a1_cons + FULL_LAYERS * b1)
+        else:
+            a1_cons = None  # noisy sweep: no conservative basis to offer
     lcfg = tr["lcfg"]  # 7B layer dims from the actual measured config
     dims = (lcfg.hidden_size, lcfg.intermediate_size, lcfg.vocab_size,
             lcfg.num_heads, lcfg.head_dim_)
@@ -873,15 +884,22 @@ def main():
     gc.collect()  # drop any buffers pinned by a failed section's frames
     try:
         # fused ring-attention CP vs SP+flash at equal global tokens
-        # (single-chip-scaled; utils/cp_microbench.py)
-        from neuronx_distributed_tpu.utils.cp_microbench import measure_cp_ratio
+        # (single-chip-scaled; utils/cp_microbench.py). Isolated =
+        # fresh subprocess per attempt with retry, the process-level
+        # re-roll for the sticky HBM-placement hazard (PROFILE.md r5 CP
+        # note); validate_long_seq's --cp rows use the same call — one
+        # basis, one estimator (VERDICT r4 #7).
+        from neuronx_distributed_tpu.utils.cp_microbench import (
+            measure_cp_ratio_isolated,
+        )
 
-        # trials=5 matches validate_long_seq's default — one shared basis
-        # (interleaved sp/cp trials inside measure_cp_ratio; VERDICT r4 #7)
-        cp_row = measure_cp_ratio(16384, trials=5)
+        cp_row = measure_cp_ratio_isolated(16384, trials=5)
         infer["cp2_zigzag_vs_sp_flash_throughput_16k"] = cp_row["cp_vs_sp_throughput"]
         infer["cp2_zigzag_vs_sp_ici_serial_16k"] = cp_row["cp_vs_sp_throughput_ici_serial"]
         infer["cp2_basis"] = cp_row["note"]
+        # estimator provenance: first-try fast mode vs best-of-N vs fallback
+        infer["cp2_attempts"] = cp_row["cp_attempts"]
+        infer["cp2_isolated"] = cp_row["cp_isolated"]
     except Exception as e:
         infer["cp_bench_error"] = f"{type(e).__name__}: {e}"[:120]
     gc.collect()
@@ -917,15 +935,13 @@ def main():
         report["train_tok_s_conservative_Lge1_slope"] = round(tokens / t_cons, 1)
         report["train_vs_baseline_conservative"] = round(
             tokens / t_cons / BASELINE_TOK_S_PER_CHIP, 3)
-        if 0 in times:
+        if 0 in times and a1_cons is not None:
             # deviation of the measured L=0 step from the L>=1 line's
             # back-extrapolated intercept — the note below is gated on THIS
             # (sign and size), not on the aggregate residual, so an outlier
-            # at some other depth can't mis-attribute the misfit to L=0
-            xs = np.asarray([L for L in sorted(cons)], np.float64)
-            ys = np.asarray([cons[int(L)] for L in xs])
-            _, a1 = np.polyfit(xs, ys, 1)
-            l0_dev = times[0] - float(a1)
+            # at some other depth can't mis-attribute the misfit to L=0;
+            # a1_cons is the SAME intercept the conservative keys used
+            l0_dev = times[0] - float(a1_cons)
             report["train_L0_excess_ms"] = round(l0_dev * 1e3, 2)
             if l0_dev > 5e-3:
                 report["train_fit_note"] = (

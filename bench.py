@@ -136,6 +136,15 @@ def step_memory_bytes(step, state, batch_data):
         return None
 
 
+def _fit_line(t: dict):
+    """Least-squares (slope, intercept) over {depth: seconds} — the ONE fit
+    implementation every projection key derives from."""
+    xs = np.asarray(sorted(t), np.float64)
+    ys = np.asarray([t[int(x)] for x in xs])
+    b, a = np.polyfit(xs, ys, 1)
+    return float(b), float(a)
+
+
 def _depth_fit(t: dict, full: int):
     """Least-squares a + b*L over the measured depths, projected to ``full``.
     Returns (projection_s, max_abs_residual_s) — residual is None when the
@@ -148,7 +157,7 @@ def _depth_fit(t: dict, full: int):
     ys = np.asarray([t[int(x)] for x in xs])
     if len(xs) < 2:
         return ys[-1] / xs[-1] * full, 0.0
-    b, a = np.polyfit(xs, ys, 1)
+    b, a = _fit_line(t)
     if b <= 0 or a < 0:
         deepest = int(xs[-1])
         return t[deepest] / deepest * full, None
@@ -862,14 +871,12 @@ def main():
     cons = {L: t for L, t in times.items() if L >= 1}
     t_cons = a1_cons = None
     if len(cons) >= 2:
-        # one polyfit feeds BOTH the conservative projection and the
+        # one fit feeds BOTH the conservative projection and the
         # L0-deviation gate below — _depth_fit's degenerate fallback would
         # otherwise let the note describe a line the keys didn't use
-        xs1 = np.asarray(sorted(cons), np.float64)
-        ys1 = np.asarray([cons[int(x)] for x in xs1])
-        b1, a1_cons = np.polyfit(xs1, ys1, 1)
+        b1, a1_cons = _fit_line(cons)
         if b1 > 0 and a1_cons >= 0:
-            t_cons = float(a1_cons + FULL_LAYERS * b1)
+            t_cons = a1_cons + FULL_LAYERS * b1
         else:
             a1_cons = None  # noisy sweep: no conservative basis to offer
     lcfg = tr["lcfg"]  # 7B layer dims from the actual measured config

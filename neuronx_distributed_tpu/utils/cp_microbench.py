@@ -11,7 +11,7 @@ import time
 
 
 def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128,
-                     tp: int = 2, trials: int = 5):
+                     tp: int = 2, trials: int = 5, allocs: int = 5):
     """Single-chip-scaled CP-vs-SP attention microbench (VERDICT r2 weak #3).
 
     THE one CP measurement basis (VERDICT r4 next #7): ``bench.py`` and
@@ -26,7 +26,11 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
     ``heads`` heads under the ZIGZAG schedule (every rank's per-step work is
     identical, so rank 0 stands in for all). Both sides time fwd + full
     backward through the same kernel entry points (`flash_block_forward` /
-    `flash_block_grads`) jitted on the real chip, min over ``trials``.
+    `flash_block_grads`) jitted on the real chip. Estimator: min per side
+    over ``allocs`` spacer-shifted operand-allocation sets x ``trials``
+    interleaved sp/cp trials per set (the HBM-placement hazard protocol —
+    see the inline protocol comment and PROFILE.md's r5 CP note; pass
+    ``allocs=1`` for wiring smokes where the hazard is irrelevant).
 
     Ring-ppermute basis, stated: ``cp_vs_sp_throughput`` EXCLUDES the ring's
     K/V transfer — the full-overlap bound, sound because the zigzag ring
@@ -121,14 +125,17 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
     #   shifted set), min per side;
     # * within each allocation set the sp/cp trials are INTERLEAVED so
     #   machine drift hits both sides alike instead of biasing the ratio.
-    allocs = 5
     ts_sp, ts_cp = [], []
     spacers = []
     compiled = False
     for a in range(allocs):
         if a:
-            # odd-MB spacer shifts every later allocation's base address
-            spacers.append(jnp.zeros(((a * 33 + 7) * 1024 * 1024 // 4,),
+            # varying-MB spacer shifts every later allocation's base
+            # address; sizes chosen so the CUMULATIVE offsets (39, 103,
+            # 199, 327 MB) are distinct odd-MB values — no two sets share
+            # an address class modulo any power-of-2 stride up to 1 MB
+            size_mb = 39 if a == 1 else 32 * a
+            spacers.append(jnp.zeros((size_mb * 1024 * 1024 // 4,),
                                      jnp.float32))
         ks = jax.random.split(jax.random.PRNGKey(a), 8)
         sp_b = [jax.random.normal(k, (h_sp, seq, head_dim), jnp.bfloat16)
@@ -166,9 +173,9 @@ def measure_cp_ratio(seq: int, cp: int = 2, heads: int = 32, head_dim: int = 128
         "cp_vs_sp_throughput_ici_serial": round(t_sp / t_cp_serial, 3),
         "ici_bytes_per_step": ici_bytes,
         "ici_ms_per_step_modeled": round(ici_ms, 3),
-        "note": ("single-chip-scaled; interleaved sp/cp trials, min over 5 "
-                 "fresh operand-allocation sets per side (HBM-placement "
-                 "hazard mitigation, PROFILE.md r5 CP note); "
+        "note": (f"single-chip-scaled; interleaved sp/cp trials, min over "
+                 f"{allocs} fresh operand-allocation set(s) per side "
+                 "(HBM-placement hazard mitigation, PROFILE.md r5 CP note); "
                  "cp_vs_sp_throughput excludes ring ppermute (full-overlap "
                  "bound), *_ici_serial adds it fully serialized at 45 GB/s "
                  "(see docstring)"),
@@ -204,20 +211,30 @@ def measure_cp_ratio_isolated(seq: int, cp: int = 2, trials: int = 5,
     ).format(repo=repo, seq=seq, cp=cp, trials=trials)
     best = None
     used = 0
+    last_err = prev_err = None
     for _ in range(attempts):
         used += 1
         try:
             r = _sp.run([_sys.executable, "-c", code], capture_output=True,
                         text=True, timeout=1200)
-        except Exception:  # noqa: BLE001 — fall through to retry/fallback
+        except Exception as e:  # noqa: BLE001 — fall through to retry/fallback
+            prev_err, last_err = last_err, f"{type(e).__name__}: {e}"[:200]
             continue
         if r.returncode != 0:
+            prev_err, last_err = last_err, (
+                f"rc={r.returncode}: " + r.stderr.strip()[-200:])
+            if prev_err == last_err:
+                # the same failure twice is deterministic (bad args, missing
+                # deps, exclusive device lock) — retrying burns a jax
+                # startup per attempt for the same outcome
+                break
             continue
         row = None
         for ln in r.stdout.splitlines():
             if ln.startswith("CPROW "):
                 row = _json.loads(ln[6:])
         if row is None:
+            prev_err, last_err = last_err, "no CPROW marker in child stdout"
             continue
         if best is None or row["cp_vs_sp_throughput"] > best["cp_vs_sp_throughput"]:
             best = row
@@ -226,6 +243,10 @@ def measure_cp_ratio_isolated(seq: int, cp: int = 2, trials: int = 5,
     if best is None:
         best = measure_cp_ratio(seq, cp=cp, trials=trials)
         best["cp_isolated"] = False
+        if last_err is not None:
+            # why the process re-roll was inert — without this the artifact
+            # could not distinguish a dead mitigation from a working one
+            best["cp_isolated_error"] = last_err
     else:
         best["cp_isolated"] = True
     best["cp_attempts"] = used

@@ -93,8 +93,9 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         tok_s, mem = measure(512, 1, tiny=True)
         print(json.dumps({"smoke": True, "seq": 512, "tokens_per_sec": round(tok_s, 1)}))
-        if args.cp:  # wiring check for the CP row (interpreted kernels, tiny)
-            row = measure_cp_ratio(512, heads=4, head_dim=32, trials=1)
+        if args.cp:  # wiring check for the CP row (interpreted kernels, tiny;
+            # allocs=1 — the HBM-placement protocol is meaningless on CPU)
+            row = measure_cp_ratio(512, heads=4, head_dim=32, trials=1, allocs=1)
             row["smoke"] = True
             print(json.dumps(row))
         return 0

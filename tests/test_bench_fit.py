@@ -1,0 +1,72 @@
+"""Unit tests for bench.py's projection math (pure host logic — the fits
+that produce the headline artifact keys; no TPU needed)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from bench import _depth_fit  # noqa: E402
+
+
+def test_depth_fit_exact_line():
+    t = {1: 0.3, 2: 0.5, 4: 0.9}  # a=0.1, b=0.2
+    proj, resid = _depth_fit(t, 32)
+    assert abs(proj - (0.1 + 32 * 0.2)) < 1e-12
+    assert resid < 1e-12
+
+
+def test_depth_fit_includes_zero_depth():
+    t = {0: 0.1, 1: 0.3, 2: 0.5}
+    proj, resid = _depth_fit(t, 32)
+    assert abs(proj - 6.5) < 1e-12 and resid < 1e-12
+
+
+def test_depth_fit_residual_reports_misfit():
+    # L=0 point 50ms above the L>=1 line: LSQ residual must expose it
+    t = {0: 0.15, 1: 0.3, 2: 0.5}
+    _, resid = _depth_fit(t, 32)
+    assert resid > 0.01
+
+
+def test_depth_fit_degenerate_falls_back_conservative():
+    # negative slope (noise) -> naive deepest-point scaling, residual None
+    t = {1: 0.5, 2: 0.4}
+    proj, resid = _depth_fit(t, 32)
+    assert resid is None
+    assert abs(proj - 0.4 / 2 * 32) < 1e-12
+
+
+def test_depth_fit_single_point():
+    proj, resid = _depth_fit({2: 0.5}, 32)
+    assert abs(proj - 8.0) < 1e-12 and resid == 0.0
+
+
+def test_depth_fit_empty_raises():
+    with pytest.raises(ValueError):
+        _depth_fit({}, 32)
+
+
+def test_conservative_gate_directions():
+    """The L0-deviation logic bench.main uses: sign of the L=0 excess over
+    the L>=1 line's intercept decides which basis the note endorses."""
+    def fit(times):
+        cons = {L: t for L, t in times.items() if L >= 1}
+        xs = np.asarray(sorted(cons), np.float64)
+        ys = np.asarray([cons[int(x)] for x in xs])
+        b1, a1 = np.polyfit(xs, ys, 1)
+        return b1, a1
+
+    # r5 measured shape: L0 above the line -> conservative is the floor
+    b1, a1 = fit({0: 0.1147, 1: 0.2630, 2: 0.4634})
+    assert b1 > 0 and a1 >= 0 and 0.1147 - a1 > 5e-3
+    # inflated L1 (spike mid-sweep): L0 sits below the line's intercept ->
+    # the note must endorse the full LSQ instead
+    b1, a1 = fit({0: 0.06, 1: 0.30, 2: 0.40})
+    assert b1 > 0 and a1 >= 0 and 0.06 - a1 < -5e-3
+    # inflated L2 steepens the slope until the intercept goes negative:
+    # bench refuses to offer a conservative basis at all in that regime
+    b1, a1 = fit({0: 0.06, 1: 0.26, 2: 0.60})
+    assert a1 < 0

@@ -1,0 +1,81 @@
+"""bench.main()'s report assembly, driven with mocked measurement sections
+(no TPU): the driver's one-shot BENCH artifact depends on this code path,
+which the CPU-smoke branch never executes — a NameError here would end a
+round with no artifact at all."""
+
+import json
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402
+
+
+class _FakeCfg:
+    hidden_size = 4096
+    intermediate_size = 11008
+    vocab_size = 32000
+    num_heads = 32
+    head_dim_ = 128
+
+
+def _run_main(monkeypatch, capsys, times, skipped=()):
+    monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(bench, "bench_train", lambda **kw: {
+        "times": dict(times),
+        "mem_L2": 123,
+        "lcfg": _FakeCfg(),
+        "skipped": list(skipped),
+        "visits": {L: 3 for L in times},
+        "windows_per_visit": 2,
+    })
+    monkeypatch.setattr(bench, "bench_inference_ttft",
+                        lambda **kw: {"ttft_ms_13b_projected_minfit": 400.0})
+    monkeypatch.setattr(bench, "bench_speculation",
+                        lambda **kw: {"spec_round_device_ms": 40.0})
+    import neuronx_distributed_tpu.utils.cp_microbench as cpm
+    monkeypatch.setattr(cpm, "measure_cp_ratio_isolated", lambda *a, **kw: {
+        "cp_vs_sp_throughput": 0.97, "cp_vs_sp_throughput_ici_serial": 0.95,
+        "note": "n", "cp_attempts": 1, "cp_isolated": True})
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"bench must print exactly ONE line, got {len(out)}"
+    return json.loads(out[0])
+
+
+def test_report_r5_shape(monkeypatch, capsys):
+    d = _run_main(monkeypatch, capsys,
+                  {0: 0.1147, 1: 0.2630, 2: 0.4634},
+                  skipped=[{"depth": 3, "pass": 0, "error": "OOM"}])
+    assert d["metric"] == "llama2_7b_train_tokens_per_sec_per_chip"
+    assert d["vs_baseline"] == pytest.approx(2881.9 / 1687.5, abs=2e-3)
+    assert d["train_fit_residual_ms"] == pytest.approx(17.37, abs=0.05)
+    assert d["train_L0_excess_ms"] == pytest.approx(52.1, abs=0.1)
+    assert d["train_vs_baseline_conservative"] == pytest.approx(1.499, abs=2e-3)
+    assert "zero-layer step costs more" in d["train_fit_note"]
+    assert d["train_windows_per_depth"] == {"0": 6, "1": 6, "2": 6}
+    assert d["train_skipped_depths"][0]["depth"] == 3
+    assert d["cp2_zigzag_vs_sp_flash_throughput_16k"] == 0.97
+    assert d["cp2_isolated"] is True
+    assert d["spec_round_device_ms"] == 40.0
+    assert d["mfu_L2_measured"] > 0 and d["step_time_L1_s"] == 0.263
+
+
+def test_report_two_point_fallback(monkeypatch, capsys):
+    # L=0 and L=3 both failed: 2-point fit, zero residual, no L0 keys
+    d = _run_main(monkeypatch, capsys, {1: 0.263, 2: 0.463})
+    assert d["train_fit_residual_ms"] == 0.0
+    assert "train_L0_excess_ms" not in d
+    assert "train_fit_note" not in d
+    assert d["train_vs_baseline_conservative"] == d["vs_baseline"]
+
+
+def test_report_l1_outlier_endorses_lsq(monkeypatch, capsys):
+    # inflated L=1 (spike): L0 sits below the L>=1 intercept -> the note
+    # must endorse the full LSQ, not the conservative keys
+    d = _run_main(monkeypatch, capsys, {0: 0.06, 1: 0.30, 2: 0.40})
+    assert d["train_L0_excess_ms"] < -5
+    assert "prefer the full-LSQ" in d["train_fit_note"]

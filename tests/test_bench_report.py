@@ -73,6 +73,27 @@ def test_report_two_point_fallback(monkeypatch, capsys):
     assert d["train_vs_baseline_conservative"] == d["vs_baseline"]
 
 
+def test_report_catastrophic_sweep_still_emits_one_line(monkeypatch, capsys):
+    # every L>=1 depth failed (e.g. OOM even at L=1): no per-layer signal
+    # exists, but the driver still needs its single JSON line
+    d = _run_main(monkeypatch, capsys, {0: 0.1147},
+                  skipped=[{"depth": 1, "pass": 0, "error": "OOM"},
+                           {"depth": 2, "pass": 0, "error": "OOM"}])
+    assert d["metric"] == "llama2_7b_train_tokens_per_sec_per_chip"
+    assert d["value"] == 0.0 and d["vs_baseline"] == 0.0
+    assert "UNMEASURED" in d["unit"]
+    assert d["train_skipped_depths"][0]["depth"] == 1
+    # what WAS measured must survive into the artifact ...
+    assert d["step_time_L0_s"] == 0.1147
+    assert d["train_step_time_s_measured"] == {"0": 0.1147}
+    # ... and the independent sections still run (mocked here)
+    assert d["ttft_ms_13b_projected_minfit"] == 400.0
+    assert d["cp2_zigzag_vs_sp_flash_throughput_16k"] == 0.97
+    assert d["spec_round_device_ms"] == 40.0
+    # no projection-derived keys may leak out of an unmeasured sweep
+    assert "mfu_7b_projected" not in d and "train_fit_note" not in d
+
+
 def test_report_l1_outlier_endorses_lsq(monkeypatch, capsys):
     # inflated L=1 (spike): L0 sits below the L>=1 intercept -> the note
     # must endorse the full LSQ, not the conservative keys

@@ -40,8 +40,10 @@ def test_depth_fit_degenerate_falls_back_conservative():
 
 
 def test_depth_fit_single_point():
+    # naive scaling, not a fit: residual must be None (not a fake 0.0) so
+    # report labels can distinguish the basis
     proj, resid = _depth_fit({2: 0.5}, 32)
-    assert abs(proj - 8.0) < 1e-12 and resid == 0.0
+    assert abs(proj - 8.0) < 1e-12 and resid is None
 
 
 def test_depth_fit_empty_raises():

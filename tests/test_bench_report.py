@@ -94,6 +94,43 @@ def test_report_catastrophic_sweep_still_emits_one_line(monkeypatch, capsys):
     assert "mfu_7b_projected" not in d and "train_fit_note" not in d
 
 
+def test_report_single_surviving_depth_labeled_degraded(monkeypatch, capsys):
+    # only L=1 survived: the value is naive scaling, and the unit must say
+    # so instead of claiming a least-squares fit with a perfect residual
+    d = _run_main(monkeypatch, capsys, {1: 0.263},
+                  skipped=[{"depth": 0, "pass": 0, "error": "X"},
+                           {"depth": 2, "pass": 0, "error": "OOM"}])
+    assert d["value"] == pytest.approx(8 * 2048 / (0.263 * 32), abs=0.06)
+    assert "DEGRADED" in d["unit"] and "naive per-layer scaling" in d["unit"]
+    assert d["train_fit_residual_ms"] is None
+    assert "train_fit_note" not in d and "train_L0_excess_ms" not in d
+    assert "mfu_7b_projected" not in d  # shares the headline's basis
+
+
+def test_report_degenerate_lsq_labeled_degraded(monkeypatch, capsys):
+    # two depths but L=2 measured FASTER than L=1 (noise): _depth_fit's
+    # non-positive-slope fallback scales the deepest point — the unit must
+    # not claim a least-squares basis for that value
+    d = _run_main(monkeypatch, capsys, {1: 0.50, 2: 0.45})
+    assert d["value"] == pytest.approx(8 * 2048 / (0.45 / 2 * 32), abs=0.06)
+    assert "DEGRADED" in d["unit"] and "degenerated" in d["unit"]
+    assert d["train_fit_residual_ms"] is None
+    assert "mfu_7b_projected" not in d  # shares the headline's basis
+
+
+def test_report_degenerate_lsq_with_valid_cons_fit_emits_no_note(
+        monkeypatch, capsys):
+    # full LSQ degenerates (L0 outlier drives slope negative) while the
+    # L>=1 conservative fit is valid: the L0-deviation note describes "the
+    # full LSQ" as the headline basis, which would contradict the DEGRADED
+    # unit — conservative keys stay (self-describing), the note must not
+    d = _run_main(monkeypatch, capsys, {0: 0.9, 1: 0.5, 2: 0.55})
+    assert "DEGRADED" in d["unit"]
+    assert "train_tok_s_conservative_Lge1_slope" in d
+    assert "train_L0_excess_ms" in d
+    assert "train_fit_note" not in d
+
+
 def test_report_l1_outlier_endorses_lsq(monkeypatch, capsys):
     # inflated L=1 (spike): L0 sits below the L>=1 intercept -> the note
     # must endorse the full LSQ, not the conservative keys

@@ -133,4 +133,3 @@ def test_profiler_noop_and_trace(tmp_path):
             jnp.ones((8,)).sum().block_until_ready()
     # jax.profiler wrote an XProf run dir under the logdir
     assert any(os.scandir(logdir)), "profiler trace directory is empty"
-    del jax

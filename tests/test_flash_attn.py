@@ -63,6 +63,65 @@ def test_gradients_match_reference(causal):
         )
 
 
+@pytest.mark.parametrize("block_q,block_k", [(32, 64), (64, 32)])
+def test_asymmetric_blocks_fwd_and_grads(block_q, block_k):
+    """Rectangular (block_q != block_k) tiles are a real production shape
+    (the CP study measured (512,1024) tiers, PROFILE.md): the grid math,
+    scratch carry, and recompute backward must not assume square blocks."""
+    q = _rand((1, 2, 128, 32), 30)
+    k = _rand((1, 2, 128, 32), 31)
+    v = _rand((1, 2, 128, 32), 32)
+    out = flash_attention(q, k, v, causal=True,
+                          block_q=block_q, block_k=block_k)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=block_q, block_k=block_k) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
+            err_msg=f"asymmetric-block grad mismatch for {name}",
+        )
+
+
+def test_asymmetric_blocks_gqa_sq_lt_sk():
+    """Rectangular tiles x compact GQA K/V x sq<sk (decode-chunk shape) in
+    one case, forward AND backward — the composition the per-feature tests
+    miss (e.g. a GQA group-indexing slip in the recompute backward that only
+    shows when the q-grid and k-grid lengths differ)."""
+    q = _rand((1, 4, 64, 32), 33)
+    k = _rand((1, 2, 128, 32), 34)
+    v = _rand((1, 2, 128, 32), 35)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=32, block_k=64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
+            err_msg=f"gqa sq<sk asymmetric-block grad mismatch for {name}",
+        )
+
+
 def test_bf16_io_fp32_accumulate():
     q = _rand((1, 2, 128, 32), 12).astype(jnp.bfloat16)
     k = _rand((1, 2, 128, 32), 13).astype(jnp.bfloat16)

@@ -177,13 +177,23 @@ def llama2_70b(**over) -> LlamaConfig:
 def llama3_8b(**over) -> LlamaConfig:
     return _preset(dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                         num_layers=32, num_heads=32, num_kv_heads=8,
-                        rope_theta=500000.0), over)
+                        rope_theta=500000.0, max_seq_len=8192), over)
 
 
 def llama31_8b(**over) -> LlamaConfig:
     """Llama-3.1-8B: 3.0 dims + the long-context rope scaling."""
     return llama3_8b(max_seq_len=over.pop("max_seq_len", 131072),
                      rope_scaling=over.pop("rope_scaling", RopeScaling()), **over)
+
+
+def llama3_70b(**over) -> LlamaConfig:
+    """Llama-3-70B (reference flagship PP workload alongside llama2-70B:
+    test/integration/llama3_70B_4layers_PP): llama2-70B dims with the
+    Llama-3 vocab/rope."""
+    return _preset(dict(vocab_size=128256, hidden_size=8192,
+                        intermediate_size=28672, num_layers=80,
+                        num_heads=64, num_kv_heads=8,
+                        rope_theta=500000.0, max_seq_len=8192), over)
 
 
 def rotary_embedding(positions: jax.Array, head_dim: int, theta: float,

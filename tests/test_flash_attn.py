@@ -42,25 +42,37 @@ def test_gqa_head_repeat():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("causal", [True, False])
-def test_gradients_match_reference(causal):
-    q = _rand((1, 2, 128, 32), 9)
-    k = _rand((1, 2, 128, 32), 10)
-    v = _rand((1, 2, 128, 32), 11)
+
+def _assert_fwd_bwd_parity(q, k, v, label, **attn_kwargs):
+    """Forward + q/k/v gradient parity of flash_attention vs the dense
+    reference for one (shapes, kwargs) configuration."""
+    out = flash_attention(q, k, v, **attn_kwargs)
+    ref = reference_attention(q, k, v, causal=attn_kwargs.get("causal", True))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4, err_msg=label)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=64, block_k=64) ** 2)
+        return jnp.sum(flash_attention(q, k, v, **attn_kwargs) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+        return jnp.sum(reference_attention(
+            q, k, v, causal=attn_kwargs.get("causal", True)) ** 2)
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
-            err_msg=f"grad mismatch for {name}",
+            err_msg=f"{label}: grad mismatch for {name}",
         )
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    q = _rand((1, 2, 128, 32), 9)
+    k = _rand((1, 2, 128, 32), 10)
+    v = _rand((1, 2, 128, 32), 11)
+    _assert_fwd_bwd_parity(q, k, v, f"square causal={causal}",
+                           causal=causal, block_q=64, block_k=64)
 
 
 @pytest.mark.parametrize("block_q,block_k", [(32, 64), (64, 32)])
@@ -71,26 +83,8 @@ def test_asymmetric_blocks_fwd_and_grads(block_q, block_k):
     q = _rand((1, 2, 128, 32), 30)
     k = _rand((1, 2, 128, 32), 31)
     v = _rand((1, 2, 128, 32), 32)
-    out = flash_attention(q, k, v, causal=True,
-                          block_q=block_q, block_k=block_k)
-    ref = reference_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
-
-    def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True,
-                                       block_q=block_q, block_k=block_k) ** 2)
-
-    def loss_ref(q, k, v):
-        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
-
-    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
-        np.testing.assert_allclose(
-            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
-            err_msg=f"asymmetric-block grad mismatch for {name}",
-        )
+    _assert_fwd_bwd_parity(q, k, v, f"asymmetric ({block_q},{block_k})",
+                           causal=True, block_q=block_q, block_k=block_k)
 
 
 def test_asymmetric_blocks_gqa_sq_lt_sk():
@@ -101,25 +95,8 @@ def test_asymmetric_blocks_gqa_sq_lt_sk():
     q = _rand((1, 4, 64, 32), 33)
     k = _rand((1, 2, 128, 32), 34)
     v = _rand((1, 2, 128, 32), 35)
-    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
-    ref = reference_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
-
-    def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True,
-                                       block_q=32, block_k=64) ** 2)
-
-    def loss_ref(q, k, v):
-        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
-
-    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
-        np.testing.assert_allclose(
-            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-4,
-            err_msg=f"gqa sq<sk asymmetric-block grad mismatch for {name}",
-        )
+    _assert_fwd_bwd_parity(q, k, v, "gqa sq<sk asymmetric",
+                           causal=True, block_q=32, block_k=64)
 
 
 def test_bf16_io_fp32_accumulate():

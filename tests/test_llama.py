@@ -287,3 +287,35 @@ def test_cp_config_propagates_to_model():
     model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg), ids)
     assert model.module.config.context_parallel
     assert model.mesh.shape["cp"] == 2
+
+
+def test_model_presets_are_consistent():
+    """Every published preset must be internally consistent: heads divide
+    hidden, kv heads divide heads (GQA), and the flagship dims match the
+    published architectures (reference workloads: llama2 7B/13B/70B,
+    llama3 8B/70B, llama3.1 8B)."""
+    from neuronx_distributed_tpu.models.llama import (
+        llama2_7b, llama2_13b, llama2_70b, llama3_8b, llama31_8b, llama3_70b)
+
+    # (hidden, inter, layers, heads, kv, vocab, max_seq) per published arch
+    presets = {
+        "llama2_7b": (llama2_7b(), 4096, 11008, 32, 32, 32, 32000, 4096),
+        "llama2_13b": (llama2_13b(), 5120, 13824, 40, 40, 40, 32000, 4096),
+        "llama2_70b": (llama2_70b(), 8192, 28672, 80, 64, 8, 32000, 4096),
+        "llama3_8b": (llama3_8b(), 4096, 14336, 32, 32, 8, 128256, 8192),
+        "llama31_8b": (llama31_8b(), 4096, 14336, 32, 32, 8, 128256, 131072),
+        "llama3_70b": (llama3_70b(), 8192, 28672, 80, 64, 8, 128256, 8192),
+    }
+    for name, (cfg, hidden, inter, layers, heads, kv, vocab, mx) in presets.items():
+        assert cfg.hidden_size == hidden, name
+        assert cfg.intermediate_size == inter, name
+        assert cfg.num_layers == layers, name
+        assert cfg.num_heads == heads and cfg.num_kv_heads == kv, name
+        assert cfg.vocab_size == vocab, name
+        assert cfg.max_seq_len == mx, name
+        assert cfg.hidden_size % cfg.num_heads == 0, name
+        assert cfg.num_heads % cfg.num_kv_heads == 0, name
+    # llama3 family uses the 500k rope base; llama3.1 adds the NTK scaling
+    assert llama3_70b().rope_theta == 500000.0
+    assert llama31_8b().rope_scaling is not None
+    assert llama3_8b().rope_scaling is None

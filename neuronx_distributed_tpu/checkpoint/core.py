@@ -33,7 +33,7 @@ import itertools
 import json
 import logging
 import threading
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
 import jax

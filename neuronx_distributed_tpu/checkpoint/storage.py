@@ -17,7 +17,7 @@ import logging
 import os
 import shutil
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 logger = logging.getLogger("nxd")
 

@@ -3,7 +3,7 @@ checkpoint callback role, re-homed onto the in-repo checkpoint core)."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from neuronx_distributed_tpu.utils import get_logger
 

@@ -8,7 +8,7 @@ subclass and implement :meth:`configure_model`, :meth:`model_inputs` and
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Dict
 
 import jax
 import optax

@@ -10,7 +10,7 @@ forked per device).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Iterator, Optional, Sequence
+from typing import Any, Dict, Iterator, Optional, Sequence
 
 import jax
 import numpy as np

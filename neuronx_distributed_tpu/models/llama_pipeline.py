@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from flax.core import meta
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.models.llama import (
     LlamaConfig,

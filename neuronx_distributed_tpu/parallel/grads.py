@@ -26,7 +26,7 @@ Capability-parity with the reference's ``parallel_layers/grads.py``
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

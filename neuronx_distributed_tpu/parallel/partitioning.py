@@ -16,7 +16,6 @@ use to apply them.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 from flax import linen as nn

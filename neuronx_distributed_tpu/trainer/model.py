@@ -16,7 +16,6 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from flax.core import meta
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from neuronx_distributed_tpu.parallel import mesh as ps
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
-import jax
 import optax
 
 from neuronx_distributed_tpu.optimizer.adamw import adamw_fp32_master

@@ -18,7 +18,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
-from typing import Dict, Optional
+from typing import Dict
 
 _LEVELS: Dict[str, int] = {
     "off": logging.CRITICAL + 10,

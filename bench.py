@@ -267,13 +267,15 @@ def _fused_decode_window(lm, cache, fused_steps=16, calls=2, windows=3):
     of the step intercept is dispatch (PROFILE.md r5 decode study)."""
     f = lm.compile_decode_fused(fused_steps)
     tok = jnp.zeros((lm.max_batch, 1), jnp.int32)
-    toks, cache, tok = f(lm.params, cache, tok)
+    rng = jax.random.key(0)
+    done = jnp.zeros((lm.max_batch,), bool)
+    toks, cache, tok, rng, done = f(lm.params, cache, tok, rng, done)
     int(np.asarray(toks)[0, 0])   # warm + sync
     best = float("inf")
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(calls):
-            toks, cache, tok = f(lm.params, cache, tok)
+            toks, cache, tok, rng, done = f(lm.params, cache, tok, rng, done)
         int(np.asarray(toks)[-1, 0])
         best = min(best, (time.perf_counter() - t0) / (fused_steps * calls))
     return best
@@ -822,6 +824,83 @@ def bench_speculation(target_layers=8, draft_layers=2, num_draft=4,
         del lm8
         gc.collect()
 
+    # --- fused single-program speculation (the tentpole serving fast path):
+    # the ENTIRE round — propose scan, chunked verify, accept/rollback,
+    # cache compaction — lives in one XLA program, R rounds per dispatch.
+    # Draft = the genuinely small 2-layer copy, int8-quantized (VERDICT r5
+    # next #3: the configuration that should actually win) ------------------
+    fusedspec = {}
+    try:
+        from neuronx_distributed_tpu.inference.causal_lm import _set_cache_index
+        from neuronx_distributed_tpu.inference.speculative import (
+            _compile_block,
+            speculative_decode_fused,
+        )
+        from neuronx_distributed_tpu.quantization.core import quantize_params
+
+        R = 8
+        draft8 = CausalLM(d_cfg, quantize_params(d_params), LlamaForCausalLM,
+                          buckets=(prompt_len,), max_batch=1).compile()
+        # device window over the R-round block program: chained calls (caches
+        # donated through), ONE host fetch at the window edge — per-round
+        # device cost with the dispatch amortized R-fold
+        _, t_cf = lm._prefill[prompt_len](lm.params, jnp.asarray(prompt))
+        _, d_cf = draft8._prefill[prompt_len](draft8.params, jnp.asarray(prompt))
+        lens0 = jnp.asarray([prompt_len], jnp.int32)
+        t_cf = _set_cache_index(t_cf, lens0)
+        d_cf = _set_cache_index(d_cf, lens0)
+        rng0 = jax.random.key(0)
+        # max_new huge => rounds never freeze inside the timing window
+        block = _compile_block(lm, draft8, t_cf, d_cf, rng0, num_draft, R,
+                               True, 1.0, None, 0, 1 << 30)
+        state = (t_cf, d_cf, jnp.int32(1), jnp.int32(prompt_len),
+                 jnp.int32(1), jnp.bool_(False), rng0)
+
+        def blk_step(toks, *st):
+            out_ = block(lm.params, draft8.params, *st)
+            return (out_[7],) + out_[:7]
+
+        blk_ms = window(blk_step, jnp.zeros((R, num_draft + 1), jnp.int32),
+                        *state, iters=3) * 1e3
+        fusedspec["spec_fused_rounds_per_block"] = R
+        fusedspec["spec_fused_block_device_ms"] = round(blk_ms, 2)
+        fusedspec["spec_fused_round_device_ms"] = round(blk_ms / R, 2)
+        # end-to-end wall clock (prefill + blocks + host reads), warmed: the
+        # dispatch amortization is the whole point, so measure it end to end
+        n_tok = 64
+        # warmups must hit the SAME static configs as the timed runs (the
+        # fused-block key includes max_new_tokens; generate only enters the
+        # fused-16 path when >16 tokens remain) or the timed window would
+        # pay the XLA compile it claims to amortize
+        speculative_decode_fused(lm, draft8, prompt, max_new_tokens=n_tok,
+                                 num_draft=num_draft, rounds_per_block=R)
+        t0 = time.perf_counter()
+        fres = speculative_decode_fused(lm, draft8, prompt,
+                                        max_new_tokens=n_tok,
+                                        num_draft=num_draft,
+                                        rounds_per_block=R)
+        spec_tps = int(fres.lengths[0]) / (time.perf_counter() - t0)
+        lm.generate(prompt, max_new_tokens=24, fused_chunk=16)  # warm plain
+        t0 = time.perf_counter()
+        lm.generate(prompt, max_new_tokens=n_tok, fused_chunk=16)
+        plain_tps = n_tok / (time.perf_counter() - t0)
+        fusedspec["spec_fused_tokens_per_sec_int8draft2L"] = round(spec_tps, 1)
+        fusedspec["spec_fused_plain16_tokens_per_sec"] = round(plain_tps, 1)
+        fusedspec["spec_speedup_fused_int8draft2L"] = round(
+            spec_tps / plain_tps, 3)
+        fusedspec["spec_fused_acceptance_int8draft2L"] = (
+            fres.stats or {}).get("acceptance_rate")
+        fusedspec["spec_fused_block_calls"] = (fres.stats or {}).get(
+            "fused_block_calls")
+        fusedspec["spec_speedup_fused_basis"] = (
+            "end-to-end wall clock, warmed: fused speculation (2-layer int8 "
+            "draft, R=8 rounds/dispatch) vs fused-16 plain greedy decode, "
+            "both ~2 host ops per device program")
+        del draft8, t_cf, d_cf, block, state
+    except Exception as e:  # noqa: BLE001 — additive, never fatal
+        fusedspec["spec_fused_error"] = f"{type(e).__name__}: {e}"[:120]
+    gc.collect()
+
     out = {
         "spec_target_layers": target_layers,
         "spec_draft_layers": draft_layers,
@@ -850,17 +929,59 @@ def bench_speculation(target_layers=8, draft_layers=2, num_draft=4,
     return out
 
 
+# the headline subset printed as the FINAL stdout line: short numeric keys
+# only, so a 2000-byte tail capture of the run always parses (VERDICT r5
+# weak #1: BENCH_r05.json tail-truncated to parsed:null). The FULL report —
+# long unit strings, per-depth dicts, skip lists — lives in the
+# BENCH_REPORT.json sidecar next to this script.
+HEADLINE_KEYS = (
+    "metric", "value", "vs_baseline", "train_measured",
+    "train_fit_residual_ms", "train_vs_baseline_conservative",
+    "mfu_7b_projected",
+    "ttft_ms_13b_projected_p50fit", "ttft_device_ms_13b_projected",
+    "decode_ms_per_token_13b_projected",
+    "decode_fused16_ms_per_token_13b_projected",
+    "decode_fused16_tokens_per_sec_13b_int8",
+    "cp2_zigzag_vs_sp_flash_throughput_16k",
+    "spec_round_device_ms", "spec_fused_round_device_ms",
+    "spec_speedup_fused_int8draft2L", "spec_fused_acceptance_int8draft2L",
+    "spec_acceptance_real_int8draft", "ttft_error", "spec_bench_error",
+)
+
+
+def emit_report(report: dict) -> None:
+    """Write the full report to the sidecar, print the compact headline line
+    LAST (tail-capture-proof artifact protocol). The headline carries a
+    pointer to the sidecar so a reader of either finds the other."""
+    import os
+    from pathlib import Path
+
+    path = os.environ.get("BENCH_REPORT_PATH") or str(
+        Path(__file__).resolve().with_name("BENCH_REPORT.json"))
+    try:
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        sidecar = os.path.basename(path)
+    except OSError as e:  # read-only checkout: headline still emits
+        sidecar = f"unwritable: {e}"[:80]
+    headline = {k: report[k] for k in HEADLINE_KEYS if k in report}
+    headline["full_report"] = sidecar
+    print(json.dumps(headline))
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:  # CPU smoke fallback so the script always emits a line
         step, state, batch_data, lcfg = build_step(2, 1, 256, False)
         dt, _ = timed_steps(step, state, batch_data, 2)
-        print(json.dumps({
+        emit_report({
             "metric": "cpu_smoke_train_tokens_per_sec",
             "value": round(256 / dt, 1),
             "unit": "tokens/s (tiny model, cpu smoke)",
             "vs_baseline": 0.0,
-        }))
+            "train_measured": False,
+        })
         return
 
     batch, seq = 8, 2048
@@ -868,10 +989,12 @@ def main():
     times, mem = tr["times"], tr["mem_L2"]
     tokens = batch * seq
     # catastrophic sweep (every L>=1 depth failed, e.g. a machine state that
-    # OOMs even L=1): the projection has no per-layer signal — value 0 marks
-    # it unmeasured — but the one JSON line the driver parses still carries
-    # whatever WAS measured (the L=0 step if it ran, and the independent
-    # inference/CP/speculation sections below, each already never-fatal).
+    # OOMs even L=1): the projection has no per-layer signal — value and
+    # vs_baseline are NULL and train_measured is false (a 0.0 sentinel would
+    # silently average into a downstream aggregator, ADVICE r5 low #1) — but
+    # the artifact still carries whatever WAS measured (the L=0 step if it
+    # ran, and the independent inference/CP/speculation sections below, each
+    # already never-fatal).
     measurable = any(L >= 1 for L in times)
     if measurable:
         t_full, train_resid = _depth_fit(times, FULL_LAYERS)
@@ -883,7 +1006,7 @@ def main():
         lsq_basis = train_resid is not None
     else:
         t_full, train_resid = None, None
-        tok_s_7b = 0.0
+        tok_s_7b = None
         lsq_basis = False
     # CONSERVATIVE companion projection: slope from the L>=1 points only.
     # Measured fact (r5): the zero-layer step costs ~50 ms MORE than the
@@ -943,7 +1066,7 @@ def main():
         infer["spec_bench_error"] = f"{type(e).__name__}: {e}"[:120]
     report = {
         "metric": "llama2_7b_train_tokens_per_sec_per_chip",
-        "value": round(tok_s_7b, 1),
+        "value": None if tok_s_7b is None else round(tok_s_7b, 1),
         "unit": (("tokens/s/chip (7B dims, least-squares step_time(L)=a+b*L "
                   f"over L={sorted(times)} interleaved passes, t_7B=a+32b)")
                  if lsq_basis else
@@ -953,7 +1076,9 @@ def main():
                   "did not happen or degenerated)")
                  if measurable else
                  "tokens/s/chip (UNMEASURED: every L>=1 train depth failed)"),
-        "vs_baseline": round(tok_s_7b / BASELINE_TOK_S_PER_CHIP, 3),
+        "vs_baseline": (None if tok_s_7b is None
+                        else round(tok_s_7b / BASELINE_TOK_S_PER_CHIP, 3)),
+        "train_measured": measurable,
         "train_fit_depths": sorted(times),
         "train_fit_residual_ms": (None if train_resid is None
                                   else round(train_resid * 1e3, 2)),
@@ -1011,7 +1136,7 @@ def main():
     if tr["skipped"]:
         report["train_skipped_depths"] = tr["skipped"]
     report.update(infer)
-    print(json.dumps(report))
+    emit_report(report)
 
 
 if __name__ == "__main__":

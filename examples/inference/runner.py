@@ -216,16 +216,18 @@ def cmd_benchmark(args) -> None:
     }
 
     if args.fused_chunk > 1:
-        # fused K-step greedy decode (one program per K tokens): the serving
-        # fast path; report per-token time on the same percentile surface
+        # fused K-step decode (one program per K tokens): the serving fast
+        # path; report per-token time on the same percentile surface
         fused = lm.compile_decode_fused(args.fused_chunk)
         _, cache = lm._prefill[bucket](lm.params, jnp.asarray(prompt))
-        toks, cache, tok = fused(lm.params, cache, tok)
+        rng = jax.random.key(args.seed)
+        done = jnp.zeros((lm.max_batch,), bool)
+        toks, cache, tok, rng, done = fused(lm.params, cache, tok, rng, done)
         jax.block_until_ready(toks)
         fused_ts = []
         for _ in range(max(1, args.decode_steps // args.fused_chunk)):
             t0 = time.perf_counter()
-            toks, cache, tok = fused(lm.params, cache, tok)
+            toks, cache, tok, rng, done = fused(lm.params, cache, tok, rng, done)
             int(np.asarray(toks)[-1, 0])
             fused_ts.append((time.perf_counter() - t0) / args.fused_chunk)
         report["token_generation_fused"] = percentiles(fused_ts)
@@ -238,10 +240,16 @@ def cmd_benchmark(args) -> None:
 def cmd_speculate(args) -> None:
     """Assisted decoding with a shallower draft model (same family/config,
     fewer layers — the reference's speculative runner pairs a small draft
-    checkpoint with the target the same way)."""
+    checkpoint with the target the same way). ``--fused_rounds R`` switches
+    to the single-program path (``speculative_decode_fused``): R complete
+    rounds per device dispatch, two host ops per block, token-identical to
+    the host loop."""
     import dataclasses
 
-    from neuronx_distributed_tpu.inference.speculative import speculative_generate
+    from neuronx_distributed_tpu.inference.speculative import (
+        speculative_decode_fused,
+        speculative_generate,
+    )
 
     if args.top_k or args.top_p < 1.0:
         raise SystemExit("speculate supports --sample with --temperature only "
@@ -269,12 +277,20 @@ def cmd_speculate(args) -> None:
     prompt_len = 16 if args.tiny else 128
     prompt = rs.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
     # warmup compiles every program (target/draft prefill+decode, proposer,
-    # chunk verify) OUTSIDE the timed window — cmd_generate's discipline
-    run = lambda n, rng, stats=False: speculative_generate(  # noqa: E731
-        lm, draft, prompt, max_new_tokens=n,
-        num_draft=args.num_draft, greedy=not args.sample,
-        temperature=args.temperature, rng=rng, collect_stats=stats,
-    )
+    # chunk verify / the fused R-round block) OUTSIDE the timed window —
+    # cmd_generate's discipline
+    if args.fused_rounds > 0:
+        run = lambda n, rng, stats=False: speculative_decode_fused(  # noqa: E731
+            lm, draft, prompt, max_new_tokens=n,
+            num_draft=args.num_draft, rounds_per_block=args.fused_rounds,
+            greedy=not args.sample, temperature=args.temperature, rng=rng,
+        )
+    else:
+        run = lambda n, rng, stats=False: speculative_generate(  # noqa: E731
+            lm, draft, prompt, max_new_tokens=n,
+            num_draft=args.num_draft, greedy=not args.sample,
+            temperature=args.temperature, rng=rng, collect_stats=stats,
+        )
     run(2, jax.random.key(args.seed + 1))
     # timed pass WITHOUT the per-submodel syncs (they add 2 host round-trips
     # per round and would bias tokens_per_sec down); a second short
@@ -474,8 +490,13 @@ def main(argv=None) -> None:
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--num_draft", type=int, default=4)
         p.add_argument("--fused_chunk", type=int, default=0,
-                       help="K>1: greedy decode in K-step fused device "
-                            "programs (one dispatch per K tokens)")
+                       help="K>1: decode in K-step fused device programs "
+                            "(one dispatch per K tokens; any sampler, "
+                            "per-token EOS)")
+        p.add_argument("--fused_rounds", type=int, default=0,
+                       help="speculate: R>0 runs R complete speculative "
+                            "rounds per device dispatch "
+                            "(speculative_decode_fused)")
         p.add_argument("--draft_layers", type=int, default=None)
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")

@@ -1,0 +1,76 @@
+"""Cross-version JAX API shims.
+
+The package is written against the current top-level collective API
+(``jax.shard_map`` with ``check_vma``/``axis_names``, ``jax.set_mesh``);
+older jaxlibs (< 0.5) ship the same machinery under
+``jax.experimental.shard_map`` with the pre-rename keyword surface
+(``check_rep``, ``auto``).  Installing forward-compatible aliases once at
+package import keeps every call site on the modern spelling — when the
+toolchain moves forward the shims become no-ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def _install() -> None:
+    # Modern jax defaults the partitionable threefry ON, making RNG draws
+    # invariant to output sharding — every cross-TP parity test (and the
+    # sharded-init discipline in trainer/model.py) assumes that invariance.
+    # This jax still defaults it off, where a sharded out_sharding silently
+    # CHANGES the drawn values.
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs,
+                      check_vma=None, axis_names=None, **kw):
+            # keyword renames: check_vma -> check_rep; axis_names (the MANUAL
+            # axes) -> auto (its complement over the mesh)
+            if check_vma is not None:
+                kw.setdefault("check_rep", check_vma)
+            # axis_names requests PARTIAL-manual (the named axes manual, the
+            # rest GSPMD-auto). This jax's partial-auto mode is broken twice
+            # over: axis_index of a manual axis lowers to a PartitionId op
+            # the SPMD partitioner rejects, and mixed manual-subgroup
+            # shardings hard-crash the partitioner (spmd_partitioner.cc
+            # IsManualSubgroup check). Fall back to FULL-manual over the
+            # whole mesh: replicated in/out specs make the auto axes compute
+            # redundantly — numerically identical, and the in-region
+            # sharding constraints that partial-auto would have honored are
+            # dropped by `constrain` (see partitioning.constrain's manual-
+            # region guard). Redundant-but-correct beats not-compiling; on a
+            # jax with native jax.shard_map none of this shim applies.
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        # static axis size inside shard_map/pmap tracing (new jax exposes it
+        # as lax.axis_size; the old axis env carries the same information)
+        def axis_size(axis_name):
+            from jax._src import core as _core
+
+            return _core.get_axis_env().axis_size(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            # pre-ambient-mesh jax: Mesh is itself the context manager that
+            # makes axis names resolvable inside jit
+            with mesh:
+                yield mesh
+
+        jax.set_mesh = set_mesh
+
+
+_install()

@@ -292,7 +292,7 @@ def hf_to_nxd_bert(hf: Dict[str, np.ndarray], config,
                    dtype: Optional[Any] = None) -> PyTree:
     cfg = config
     L, H, N = cfg.num_layers, cfg.hidden_size, cfg.num_heads
-    D = cfg.head_dim
+    D = cfg.head_dim_
     dt = dtype or cfg.param_dtype
 
     def t(name):
@@ -349,7 +349,7 @@ def hf_to_nxd_bert(hf: Dict[str, np.ndarray], config,
 
 def nxd_to_hf_bert(params: PyTree, config, dtype: Any = np.float32) -> Dict[str, np.ndarray]:
     cfg = config
-    L, H, N, D = cfg.num_layers, cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    L, H, N, D = cfg.num_layers, cfg.hidden_size, cfg.num_heads, cfg.head_dim_
     b = params["bert"]
     blk = b["layers"]["block"]
 

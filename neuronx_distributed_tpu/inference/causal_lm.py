@@ -163,23 +163,33 @@ class CausalLM:
         )
         return self
 
-    def compile_decode_fused(self, steps: int):
-        """Compile ``steps`` greedy decode iterations as ONE device program
+    def compile_decode_fused(self, steps: int, sampler: Optional[Sampler] = None,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: int = 0):
+        """Compile ``steps`` decode iterations as ONE device program
         (``lax.scan`` over the single-token step, cache donated through).
 
         Rationale: step decode pays one program dispatch per token; at small
         per-layer cost that fixed dispatch dominates (the ~5 ms/token decode
         intercept attributed in PROFILE.md's r5 study). Fusing K steps
-        amortizes it K-fold. Greedy-only: the argmax feed-forward lives
-        inside the scan, so sampling params cannot vary per token. The param
-        transform (e.g. int8 dequant) is applied INSIDE the scan body —
-        quantized weights stay in HBM and XLA fuses the dequant into each
-        step's matmuls, exactly like the single-step program.
+        amortizes it K-fold. Any :class:`Sampler` works — the scan body
+        carries an rng key and splits once per step (the SAME fold-in order
+        as the stepwise path, so greedy and sampled outputs are
+        token-identical to step decode). Per-token EOS is handled inside the
+        scan: the emitted token at position i is frozen to ``pad_token_id``
+        for rows already done BEFORE step i, and ``done`` latches on the eos
+        token — the device may still compute (never emit) tokens past a
+        row's EOS, exactly like the step path keeps decoding finished rows
+        until the whole batch is done. The param transform (e.g. int8
+        dequant) is applied INSIDE the scan body — quantized weights stay in
+        HBM and XLA fuses the dequant into each step's matmuls, exactly like
+        the single-step program.
 
-        Returns the compiled program
-        ``(params, cache, tok (b,1)) -> (tokens (steps, b), cache, next_tok)``
-        where ``tokens[i]`` is the token sampled at iteration ``i`` and
-        ``next_tok`` feeds a follow-up call. Cached per ``steps``.
+        Returns the compiled program ``(params, cache, tok (b,1), rng,
+        done (b,)) -> (tokens (steps, b), cache, next_tok, rng, done)`` where
+        ``tokens[i]`` is the (EOS-masked) token emitted at iteration ``i``
+        and ``next_tok``/``rng``/``done`` feed a follow-up call. Cached per
+        ``(steps, sampler, eos, pad)``.
 
         Reference counterpart: the token-generation submodel of the CTX/TKG
         split (examples/inference/modules/model_base.py) — one traced
@@ -188,22 +198,31 @@ class CausalLM:
         """
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
-        if steps in self._decode_fused:
-            return self._decode_fused[steps]
+        sampler = sampler or Sampler(greedy=True)
+        key = (steps, sampler, eos_token_id, pad_token_id)
+        if key in self._decode_fused:
+            return self._decode_fused[key]
 
-        def fused_fn(params, cache, tok):
+        def fused_fn(params, cache, tok, rng, done):
             def body(carry, _):
-                cache, tok = carry
+                cache, tok, rng, done = carry
+                rng, sub = jax.random.split(rng)
                 logits, mut = self.model.apply(
                     {"params": self._resolve(params), "cache": cache}, tok,
                     mutable=["cache"]
                 )
-                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-                return (mut["cache"], nxt[:, None]), nxt
+                nxt = sampler(logits[:, 0, :], sub)
+                # emission masked by done-BEFORE-this-step (the stepwise
+                # record() order); the raw token still feeds the next step,
+                # also matching stepwise
+                out = jnp.where(done, jnp.int32(pad_token_id), nxt)
+                if eos_token_id is not None:
+                    done = done | (nxt == eos_token_id)
+                return (mut["cache"], nxt[:, None], rng, done), out
 
-            (cache, tok), toks = jax.lax.scan(
-                body, (cache, tok), None, length=steps)
-            return toks, cache, tok
+            (cache, tok, rng, done), toks = jax.lax.scan(
+                body, (cache, tok, rng, done), None, length=steps)
+            return toks, cache, tok, rng, done
 
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
@@ -214,11 +233,12 @@ class CausalLM:
 
         cache0 = jax.eval_shape(prefill_shape, self.params, ids0)
         tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
-        self._decode_fused[steps] = (
+        done0 = jnp.zeros((self.max_batch,), bool)
+        self._decode_fused[key] = (
             jax.jit(fused_fn, donate_argnums=(1,))
-            .lower(self.params, cache0, tok0).compile()
+            .lower(self.params, cache0, tok0, jax.random.key(0), done0).compile()
         )
-        return self._decode_fused[steps]
+        return self._decode_fused[key]
 
     def _bucket_for(self, s: int) -> int:
         for b in self.buckets:
@@ -354,17 +374,16 @@ class CausalLM:
 
         ``fused_chunk > 1`` decodes in K-token fused device programs
         (``compile_decode_fused``): one dispatch + host read per K tokens
-        instead of per token. Greedy samplers only (the argmax feed-forward
-        lives inside the scan); EOS is honored at chunk granularity — the
-        device may compute (never return) up to K-1 tokens past a row's
-        EOS, exactly like the step path keeps decoding rows that finished
-        before the whole batch did."""
+        instead of per token. Works with ANY sampler (the scan body carries
+        the rng and splits per step in the stepwise order) and handles EOS
+        per token inside the scan (post-EOS emissions frozen to
+        ``pad_token_id``) — output is token-identical to the stepwise path;
+        the device may still compute (never return) up to K-1 tokens past
+        the point where every row finished."""
         if self._decode is None:
             self.compile()
         sampler = sampler or Sampler(greedy=True)
         use_fused = fused_chunk and fused_chunk > 1
-        if use_fused and not (sampler.greedy or sampler.temperature == 0.0):
-            raise ValueError("fused_chunk requires a greedy sampler")
         rng = rng if rng is not None else jax.random.key(0)
         b, s = prompt_ids.shape
         if b > self.max_batch:
@@ -401,7 +420,7 @@ class CausalLM:
 
         def record(tok_np: np.ndarray, t: int) -> bool:
             nonlocal done, gen_len
-            out[:, t] = np.where(done, 0, tok_np)
+            out[:, t] = np.where(done, pad_token_id, tok_np)
             gen_len = np.where(done, gen_len, gen_len + 1)
             if eos_token_id is not None:
                 done = done | (tok_np == eos_token_id)
@@ -413,15 +432,19 @@ class CausalLM:
         t = 1
         while t < max_new_tokens and not finished:
             if use_fused and max_new_tokens - t >= fused_chunk:
-                fused = self.compile_decode_fused(fused_chunk)
-                toks, cache, _ = fused(
-                    self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32))
+                fused = self.compile_decode_fused(
+                    fused_chunk, sampler, eos_token_id, pad_token_id)
+                toks, cache, next_tok, rng, _ = fused(
+                    self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32),
+                    rng, jnp.asarray(done))
                 for row in np.asarray(toks):                      # (K, max_batch)
-                    tok_np = row
-                    finished = record(tok_np, t)
+                    finished = record(row, t)
                     t += 1
                     if finished:
                         break
+                # raw last sampled token feeds the next program, matching
+                # the stepwise feed discipline (rows already emitted masked)
+                tok_np = np.asarray(next_tok)[:, 0]
                 continue
             rng, sub = jax.random.split(rng)
             step_logits, cache = self._decode(

@@ -56,10 +56,13 @@ class BertConfig:
     attention_block_k: int = 128
     remat_policy: Optional[str] = None
     sequence_parallel: bool = False  # accepted for config parity; encoder runs full-seq
+    # explicit head_dim override (head padding appends heads, after which
+    # hidden_size // num_heads no longer equals it — same contract as Llama)
+    head_dim: Optional[int] = None
 
     @property
-    def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
 
 
 def bert_large(**over) -> BertConfig:
@@ -84,7 +87,7 @@ class BertSelfAttention(nn.Module):
         q, k, v = GQAQKVColumnParallelLinear(
             num_heads=cfg.num_heads,
             num_kv_heads=cfg.num_heads,
-            head_dim=cfg.head_dim,
+            head_dim=cfg.head_dim_,
             use_bias=True,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,

@@ -26,51 +26,83 @@ def get_number_of_extra_heads(num_heads: int, tp_degree: int) -> int:
     return (-num_heads) % tp_degree
 
 
-def pad_llama_heads(params: PyTree, config, tp_degree: int) -> Tuple[PyTree, Any]:
-    """Zero-pad query heads of a Llama-family param tree (stacked or not) to
-    the next multiple of ``tp_degree``; returns ``(padded_params,
-    padded_config)``. KV heads are NOT padded — non-dividing KV counts use
-    ``kv_size_multiplier`` replication (reference qkv_linear.py:34-78), which
-    composes with this."""
-    extra = get_number_of_extra_heads(config.num_heads, tp_degree)
+# attention OUTPUT projections per family: the RowParallel kernel whose input
+# rows are per-head — zero rows for the padded heads are what makes padding
+# exact. Llama/Mixtral/NeoX name it o_proj; BERT's attention output module is
+# `attention.output` (reference pad_model walks modules by name the same way).
+_OUT_PROJ_PATTERNS = (
+    ("o_proj", "['kernel']"),
+    ("attention", "['output']['kernel']"),
+)
+
+
+def pad_model(params: PyTree, config, tp_degree: int) -> Tuple[PyTree, Any]:
+    """Family-generic head padding (reference ``pad.py`` ``pad_model``:28):
+    walks ANY supported param tree — Llama, Mixtral (MHA configs), GPT-NeoX,
+    BERT — and zero-pads attention heads so ``num_heads % tp_degree == 0``.
+    Returns ``(padded_params, padded_config)``.
+
+    What gets padded (matched by path, so stacked-layer trees work):
+
+    * ``q_kernel``/``k_kernel``/``v_kernel`` ((..., hidden, N, D) — the GQA
+      QKV layer's layout for every family) gain ``extra`` zero heads;
+    * their per-head biases (``q_bias``/``k_bias``/``v_bias``, (..., N, D) —
+      NeoX and BERT QKV carry biases) gain zero rows;
+    * the attention output projection kernel ((..., N*D, H)) gains ``extra``
+      blocks of ``D`` zero INPUT rows.
+
+    Exactness argument (the reference's): padded Q heads attend over
+    zero-K/V heads and produce garbage outputs, but the output-projection
+    rows for those heads are zero, so every logit is bit-identical to the
+    unpadded model. MHA only — appending Q heads to a GQA model would regroup
+    existing heads onto wrong KV heads (use ``kv_size_multiplier``
+    replication instead, reference qkv_linear.py:34-78)."""
+    num_heads = config.num_heads
+    num_kv = getattr(config, "num_kv_heads", num_heads)  # BERT: MHA implicit
+    extra = get_number_of_extra_heads(num_heads, tp_degree)
     if extra == 0:
         return params, config
-    n, d = config.num_heads, config.head_dim_
-    mha = config.num_kv_heads == config.num_heads
-    if not mha:
-        # appending Q heads changes n//n_kv, so EXISTING heads would be
-        # regrouped onto the wrong KV heads — silently wrong outputs. GQA
-        # models make their heads divide tp via kv_size_multiplier instead
-        # (reference qkv_linear.py:34-78).
+    if num_kv != num_heads:
         raise ValueError(
             f"head padding supports MHA only (num_kv_heads == num_heads); "
-            f"got {config.num_kv_heads} != {config.num_heads} — use "
-            f"kv_size_multiplier for GQA"
+            f"got {num_kv} != {num_heads} — use kv_size_multiplier for GQA"
         )
+    d = config.head_dim_ if hasattr(config, "head_dim_") else config.head_dim
+    n = num_heads
 
     def pad_leaf(path, leaf):
         pstr = jax.tree_util.keystr(path)
         # MHA pads K/V alongside Q (reference pads the whole attention);
         # padded KV heads are zero -> uniform softmax over zero values -> 0,
-        # and the o_proj rows are zero regardless
-        q_like = ("['q_kernel']",) + ((("['k_kernel']", "['v_kernel']")) if mha else ())
-        if pstr.endswith(q_like):
+        # and the out-projection rows are zero regardless
+        if pstr.endswith(("['q_kernel']", "['k_kernel']", "['v_kernel']")):
             # (..., H, N, D) -> (..., H, N+extra, D)
             pad = [(0, 0)] * (leaf.ndim - 2) + [(0, extra), (0, 0)]
             return jnp.pad(leaf, pad)
-        if "o_proj" in pstr and pstr.endswith("['kernel']"):
-            # (..., N*D, H) -> (..., (N+extra)*D, H): zero ROWS for new heads
-            lead = leaf.shape[:-2]
-            rows = leaf.reshape(*lead, n, d, leaf.shape[-1])
-            pad = [(0, 0)] * (rows.ndim - 3) + [(0, extra), (0, 0), (0, 0)]
-            rows = jnp.pad(rows, pad)
-            return rows.reshape(*lead, (n + extra) * d, leaf.shape[-1])
+        if pstr.endswith(("['q_bias']", "['k_bias']", "['v_bias']")):
+            # (..., N, D) -> (..., N+extra, D): zero bias for new heads
+            pad = [(0, 0)] * (leaf.ndim - 2) + [(0, extra), (0, 0)]
+            return jnp.pad(leaf, pad)
+        for marker, suffix in _OUT_PROJ_PATTERNS:
+            if marker in pstr and pstr.endswith(suffix):
+                # (..., N*D, H) -> (..., (N+extra)*D, H): zero ROWS for new
+                # heads (their bias, if any, is per-OUTPUT — untouched)
+                lead = leaf.shape[:-2]
+                rows = leaf.reshape(*lead, n, d, leaf.shape[-1])
+                pad = [(0, 0)] * (rows.ndim - 3) + [(0, extra), (0, 0), (0, 0)]
+                rows = jnp.pad(rows, pad)
+                return rows.reshape(*lead, (n + extra) * d, leaf.shape[-1])
         return leaf
 
     padded = jax.tree_util.tree_map_with_path(pad_leaf, params)
     # head_dim must stay explicit: hidden_size//num_heads no longer equals it
-    new_cfg = dataclasses.replace(
-        config, num_heads=n + extra, head_dim=d,
-        num_kv_heads=config.num_kv_heads + (extra if mha else 0),
-    )
-    return padded, new_cfg
+    over: dict = {"num_heads": n + extra, "head_dim": d}
+    if hasattr(config, "num_kv_heads"):
+        over["num_kv_heads"] = num_kv + extra
+    return padded, dataclasses.replace(config, **over)
+
+
+def pad_llama_heads(params: PyTree, config, tp_degree: int) -> Tuple[PyTree, Any]:
+    """Back-compat alias for the Llama family — :func:`pad_model` is the
+    generic walk (same zero-o_proj-row exactness argument, every family)."""
+    return pad_model(params, config, tp_degree)

@@ -34,8 +34,15 @@ ACT_CP = P(DP_AXES, "cp", None)        # sequence sharded over CP (ring attentio
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:
     """``with_sharding_constraint`` against the global mesh; no-op when
-    parallel state is uninitialized (single-device unit tests)."""
+    parallel state is uninitialized (single-device unit tests) or when
+    tracing inside a manual (shard_map/pmap) region — constraints are GSPMD
+    hints and there is no GSPMD inside full-manual regions (the compat
+    shim's full-manual fallback routes partial-manual callers here)."""
     if not ps.model_parallel_is_initialized():
+        return x
+    from jax._src import core as _core
+
+    if _core.get_axis_env().axis_sizes:
         return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(ps.get_mesh(), spec))
 

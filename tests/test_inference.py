@@ -540,7 +540,7 @@ def test_fused_decode_matches_stepwise():
         np.testing.assert_array_equal(got.lengths, ref.lengths)
 
 
-def test_fused_decode_eos_and_sampler_guard():
+def test_fused_decode_eos_and_steps_guard():
     cfg = LlamaConfig(**TINY)
     ids = np.asarray(jax.random.randint(jax.random.PRNGKey(5), (1, 8), 1, 127))
     params = _params(cfg, jnp.asarray(ids))
@@ -552,8 +552,159 @@ def test_fused_decode_eos_and_sampler_guard():
     r_fused = lm.generate(ids, max_new_tokens=12, eos_token_id=eos, fused_chunk=4)
     np.testing.assert_array_equal(r_fused.tokens, r_step.tokens)
     np.testing.assert_array_equal(r_fused.lengths, r_step.lengths)
-    with pytest.raises(ValueError, match="greedy"):
-        lm.generate(ids, max_new_tokens=4, fused_chunk=4,
-                    sampler=Sampler(temperature=0.7))
     with pytest.raises(ValueError, match="steps"):
         lm.compile_decode_fused(0)
+
+
+def test_fused_decode_sampled_matches_stepwise():
+    """The fused K-step program carries the rng and splits once per scan
+    step — the stepwise fold-in order — so ANY sampler must emit the exact
+    stepwise token stream (the tentpole's generalization beyond greedy)."""
+    cfg = LlamaConfig(**TINY)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(8), (2, 8), 1, 127))
+    params = _params(cfg, jnp.asarray(ids))
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=2).compile()
+    for samp in (Sampler(temperature=0.8),
+                 Sampler(temperature=1.0, top_k=5),
+                 Sampler(temperature=0.9, top_p=0.9)):
+        ref = lm.generate(ids, max_new_tokens=10, sampler=samp,
+                          rng=jax.random.key(11))
+        for chunk in (3, 4, 16):  # tail fallback, divides, larger-than-run
+            got = lm.generate(ids, max_new_tokens=10, sampler=samp,
+                              rng=jax.random.key(11), fused_chunk=chunk)
+            np.testing.assert_array_equal(
+                got.tokens, ref.tokens, err_msg=f"{samp} chunk={chunk}")
+            np.testing.assert_array_equal(got.lengths, ref.lengths)
+
+
+def test_fused_decode_post_eos_frozen_to_pad():
+    """Per-token EOS inside the scan: every position after a row's EOS must
+    read pad_token_id, and rows finishing at different steps mid-chunk must
+    match the stepwise path (no chunk-granularity over-generation)."""
+    cfg = LlamaConfig(**TINY)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(9), (2, 8), 1, 127))
+    params = _params(cfg, jnp.asarray(ids))
+    lm = CausalLM(cfg, params, LlamaForCausalLM, buckets=(16,), max_batch=2).compile()
+    ref = lm.generate(ids, max_new_tokens=12)
+    # choose an eos that hits row 0 mid-chunk; row 1 keeps decoding
+    eos = int(ref.tokens[0, 3])
+    r_step = lm.generate(ids, max_new_tokens=12, eos_token_id=eos)
+    r_fused = lm.generate(ids, max_new_tokens=12, eos_token_id=eos,
+                          fused_chunk=5)
+    np.testing.assert_array_equal(r_fused.tokens, r_step.tokens)
+    np.testing.assert_array_equal(r_fused.lengths, r_step.lengths)
+    for row in range(2):
+        n = int(r_fused.lengths[row])
+        if n < 12:
+            assert r_fused.tokens[row, n - 1] == eos
+            assert (r_fused.tokens[row, n:] == 0).all()  # pad_token_id=0
+
+
+# --- single-program fused speculation (tentpole) ----------------------------
+
+def _spec_pair(seed_t=0, seed_d=99):
+    cfg = LlamaConfig(**TINY)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(6), (1, 8), 1, 127))
+    model = LlamaForCausalLM(cfg)
+    params_t = meta.unbox(model.init(jax.random.PRNGKey(seed_t), jnp.asarray(ids)))["params"]
+    params_d = meta.unbox(model.init(jax.random.PRNGKey(seed_d), jnp.asarray(ids)))["params"]
+    t_lm = CausalLM(cfg, params_t, LlamaForCausalLM, buckets=(16,), max_batch=1).compile()
+    d_lm = CausalLM(cfg, params_d, LlamaForCausalLM, buckets=(16,), max_batch=1).compile()
+    return t_lm, d_lm, ids
+
+
+def test_speculative_fused_matches_host_loop_greedy():
+    """The fused R-round program must emit BIT-IDENTICAL tokens to the host
+    loop (greedy), including the rejection path of a divergent draft, across
+    block sizes that divide / don't divide / exceed the round count."""
+    from neuronx_distributed_tpu.inference.speculative import (
+        speculative_decode_fused,
+        speculative_generate,
+    )
+
+    t_lm, d_lm, ids = _spec_pair()
+    host = speculative_generate(t_lm, d_lm, ids, max_new_tokens=12,
+                                num_draft=3, rng=jax.random.key(7))
+    for rpb in (1, 3, 16):
+        fused = speculative_decode_fused(
+            t_lm, d_lm, ids, max_new_tokens=12, num_draft=3,
+            rounds_per_block=rpb, rng=jax.random.key(7))
+        np.testing.assert_array_equal(fused.tokens, host.tokens,
+                                      err_msg=f"rounds_per_block={rpb}")
+        assert fused.stats["rounds"] == host.stats["rounds"]
+        assert fused.stats["accepted"] == host.stats["accepted"]
+        assert fused.stats["acceptance_rate"] == host.stats["acceptance_rate"]
+
+
+@pytest.mark.slow  # compiles two full fused-round programs; tier-1 keeps the
+# greedy + eos/dispatch-count exactness gates, this rides the slow lane
+def test_speculative_fused_matches_host_loop_sampled():
+    """Sampled acceptance (speculative sampling): identical rng fold-in
+    discipline -> identical accept/reject draws and residual resamples ->
+    token-identical output."""
+    from neuronx_distributed_tpu.inference.speculative import (
+        speculative_decode_fused,
+        speculative_generate,
+    )
+
+    t_lm, d_lm, ids = _spec_pair()
+    host = speculative_generate(t_lm, d_lm, ids, max_new_tokens=12,
+                                num_draft=3, greedy=False, temperature=0.8,
+                                rng=jax.random.key(3))
+    fused = speculative_decode_fused(
+        t_lm, d_lm, ids, max_new_tokens=12, num_draft=3, greedy=False,
+        temperature=0.8, rounds_per_block=4, rng=jax.random.key(3))
+    np.testing.assert_array_equal(fused.tokens, host.tokens)
+    # self-draft sampled: acceptance prob min(1, p/p) = 1 -> full length
+    t2 = _spec_pair()[0]
+    from neuronx_distributed_tpu.inference.speculative import (
+        speculative_decode_fused as sdf,
+    )
+    res = sdf(t2, t2, ids, max_new_tokens=10, num_draft=3, greedy=False,
+              temperature=0.8, rounds_per_block=3, rng=jax.random.key(5))
+    assert int(res.lengths[0]) == 10
+    assert res.stats["acceptance_rate"] == 1.0
+
+
+def test_speculative_fused_eos_and_dispatch_count():
+    """EOS stops mid-block (later rounds frozen by the length mask, post-EOS
+    slots pad) AND the dispatch contract holds: counting invocations of the
+    compiled block program shows ONE program call per R-round block — with
+    the single result fetch, <= 2 host dispatches per block."""
+    from neuronx_distributed_tpu.inference import speculative as spec
+
+    t_lm, d_lm, ids = _spec_pair()
+    host = spec.speculative_generate(t_lm, d_lm, ids, max_new_tokens=12,
+                                     num_draft=3, rng=jax.random.key(7))
+    eos = int(host.tokens[0, 5])
+
+    calls = {"n": 0}
+    orig = spec._compile_block
+
+    def counting_compile(*a, **kw):
+        compiled = orig(*a, **kw)
+
+        def wrapped(*ca, **ckw):
+            calls["n"] += 1
+            return compiled(*ca, **ckw)
+
+        return wrapped
+
+    spec._compile_block = counting_compile
+    try:
+        he = spec.speculative_generate(t_lm, d_lm, ids, max_new_tokens=12,
+                                       num_draft=3, eos_token_id=eos,
+                                       rng=jax.random.key(7))
+        fe = spec.speculative_decode_fused(
+            t_lm, d_lm, ids, max_new_tokens=12, num_draft=3, eos_token_id=eos,
+            rounds_per_block=4, rng=jax.random.key(7))
+    finally:
+        spec._compile_block = orig
+    np.testing.assert_array_equal(fe.tokens, he.tokens)
+    np.testing.assert_array_equal(fe.lengths, he.lengths)
+    n = int(fe.lengths[0])
+    assert fe.tokens[0, n - 1] == eos and (fe.tokens[0, n:] == 0).all()
+    # independently-counted program invocations == self-reported block calls,
+    # and each block performed exactly one program call
+    assert calls["n"] == fe.stats["fused_block_calls"] >= 1
+    assert fe.stats["host_dispatches_per_block"] == 2

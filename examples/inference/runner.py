@@ -17,7 +17,10 @@ Subcommands:
 * ``check-accuracy`` — greedy-token match + logit divergence report vs an
   fp32 cache-free golden (or the fp32 ``transformers`` model with
   --hf_checkpoint) — reference ``check_accuracy``:290 /
-  ``check_accuracy_logits``:352.
+  ``check_accuracy_logits``:352;
+* ``serve`` — continuous-batching engine over a synthetic arrival trace
+  (admission queue, bucketed right-sized inserts, fused K-step multi-slot
+  decode — ``ServeEngine``): throughput + queueing/latency report.
 
 Run (13B dims, TP8):
     python examples/inference/runner.py benchmark --tp 8
@@ -372,6 +375,51 @@ def cmd_medusa(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_serve(args) -> None:
+    """Continuous-batching serving over a synthetic arrival trace (the
+    tentpole serving entrypoint): requests arrive over virtual time
+    (exponential inter-arrivals, in decode blocks), the scheduler admits
+    them into KV-cache slots through bucketed right-sized prefills, and the
+    whole slot pool advances ``--fused_steps`` tokens per device dispatch
+    (``CausalLM.compile_session_decode_fused``). ``--stepwise`` replays the
+    identical schedule through per-token dispatches — the baseline the
+    fused path is measured against (token streams are bit-identical)."""
+    from neuronx_distributed_tpu.inference.engine import (
+        ServeEngine, run_trace, synthetic_trace,
+    )
+
+    lm, cfg = build_model(args)
+    lm.compile()
+    engine = ServeEngine(lm, block_steps=args.fused_steps,
+                         fused=not args.stepwise,
+                         rng=jax.random.key(args.seed))
+    prompt_lens = ((8, 12, 16) if args.tiny
+                   else (64, min(128, args.prompt_len), args.prompt_len))
+    trace = synthetic_trace(
+        args.num_requests, cfg.vocab_size, prompt_lens=prompt_lens,
+        max_new_tokens=args.max_new_tokens,
+        mean_interarrival_blocks=args.mean_interarrival,
+        seed=args.seed,
+    )
+    # warm every program the trace will hit (all insert widths per bucket +
+    # the fused block) OUTSIDE the timed window — cmd_generate's discipline
+    for s in sorted({len(item["prompt"]) for item in trace}):
+        for rows in range(1, lm.max_batch + 1):
+            lm._insert_programs(rows, lm._bucket_for(s))
+    warm = ServeEngine(lm, block_steps=args.fused_steps,
+                       fused=not args.stepwise, rng=jax.random.key(args.seed))
+    for item in trace[: min(len(trace), lm.max_batch)]:
+        warm.submit(item["prompt"], 2)
+    warm.run()
+    report = run_trace(engine, trace)
+    report.update({
+        "model": args.model + ("_tiny" if args.tiny else ""),
+        "max_batch": lm.max_batch,
+        "num_requests": args.num_requests,
+    })
+    print(json.dumps(report))
+
+
 def cmd_check_accuracy(args) -> None:
     """Correctness gate (reference runner.py ``check_accuracy``:290 +
     ``check_accuracy_logits``:352): the SERVING stack's greedy continuation
@@ -471,7 +519,8 @@ def cmd_check_accuracy(args) -> None:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("generate", "benchmark", "speculate", "medusa", "check-accuracy"):
+    for name in ("generate", "benchmark", "speculate", "medusa",
+                 "check-accuracy", "serve"):
         p = sub.add_parser(name)
         p.add_argument("--tensor_parallel_size", "--tp", type=int, default=None)
         p.add_argument("--tiny", action="store_true")
@@ -498,6 +547,17 @@ def main(argv=None) -> None:
                             "rounds per device dispatch "
                             "(speculative_decode_fused)")
         p.add_argument("--draft_layers", type=int, default=None)
+        p.add_argument("--fused_steps", type=int, default=8,
+                       help="serve: K decode steps per device dispatch for "
+                            "the whole slot pool (the fused-K knob)")
+        p.add_argument("--stepwise", action="store_true",
+                       help="serve: per-token dispatch baseline (same "
+                            "schedule, bit-identical tokens)")
+        p.add_argument("--num_requests", type=int, default=8,
+                       help="serve: synthetic arrival-trace length")
+        p.add_argument("--mean_interarrival", type=float, default=0.5,
+                       help="serve: mean request inter-arrival time in "
+                            "decode blocks (exponential)")
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")
         p.add_argument("--model", choices=["llama", "mixtral", "dbrx"],
@@ -509,7 +569,7 @@ def main(argv=None) -> None:
         force_cpu_mesh()
     {"generate": cmd_generate, "benchmark": cmd_benchmark,
      "speculate": cmd_speculate, "medusa": cmd_medusa,
-     "check-accuracy": cmd_check_accuracy}[args.cmd](args)
+     "check-accuracy": cmd_check_accuracy, "serve": cmd_serve}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -147,9 +147,13 @@ extern "C" {
 // Returns an opaque handle (heap Reader*), or nullptr on failure.
 // rank/world shard the epoch permutation across processes (world=1: no
 // sharding); requires rank < world and total_seqs >= world.
+// start_epoch/start_cursor resume the stream at an exact position (O(1) —
+// the epoch permutation is a pure function of seed+epoch, so seeking is one
+// reshuffle, not a replay): the checkpoint-resume path of the training loop.
 void* tsr_open(const char** paths, int n_paths, uint64_t seq_len,
                uint64_t batch, uint64_t shuffle_seed,
-               uint64_t rank, uint64_t world) {
+               uint64_t rank, uint64_t world,
+               uint64_t start_epoch, uint64_t start_cursor) {
   if (world == 0 || rank >= world) return nullptr;
   auto* r = new Reader();
   r->seq_len = seq_len;
@@ -173,6 +177,8 @@ void* tsr_open(const char** paths, int n_paths, uint64_t seq_len,
     delete r;
     return nullptr;
   }
+  r->epoch = start_epoch;
+  r->cursor = start_cursor;  // >= per_rank wraps in fill_batch's epoch check
   r->reshuffle();
   r->worker = std::thread([r] { r->run(); });
   return r;

@@ -68,6 +68,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.tsr_open.restype = ctypes.c_void_p
         lib.tsr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
                                  ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                                 ctypes.c_uint64, ctypes.c_uint64,
                                  ctypes.c_uint64, ctypes.c_uint64]
         lib.tsr_next.restype = ctypes.c_int
         lib.tsr_next.argtypes = [ctypes.c_void_p,
@@ -86,7 +87,16 @@ class TokenShardDataset:
 
     ``labels`` are next-token shifted; the final position's label is the
     ignore index (the synthetic generators yield seq_len+1 tokens instead —
-    shards store exactly seq_len, matching on-disk corpora)."""
+    shards store exactly seq_len, matching on-disk corpora).
+
+    The stream position is CHECKPOINTABLE in O(1): each epoch's permutation
+    is a pure function of ``shuffle_seed + epoch``, so ``(epoch, cursor)``
+    pins the stream exactly — :meth:`state_dict` after N batches and
+    :meth:`load_state_dict` on a fresh dataset resume at batch N without
+    replaying ``next()`` N times (ROADMAP #7; the reference restores its
+    DistributedSampler state the same way). The position is tracked
+    host-side per CONSUMED batch, so the native reader's prefetch run-ahead
+    never leaks into the saved state. One live iterator per dataset."""
 
     def __init__(self, paths: Sequence[str], batch_size: int,
                  shuffle: bool = True, shuffle_seed: int = 0,
@@ -130,10 +140,47 @@ class TokenShardDataset:
             raise RuntimeError("native reader requested but g++ build failed")
         self._lib = lib
         self._handle = None
+        self._total = total
+        self._epoch = 0          # stream position AFTER the last served batch
+        self._cursor = 0
+        self.batches_served = 0
 
     @property
     def using_native(self) -> bool:
         return self._lib is not None
+
+    @property
+    def _per_rank(self) -> int:
+        return self._total // self.world_size
+
+    # --- checkpointable stream position ---------------------------------
+
+    def state_dict(self) -> Dict[str, int]:
+        """Position after the last served batch — save with the training
+        checkpoint; a fresh dataset given this via :meth:`load_state_dict`
+        serves the very next batch a straight run would."""
+        return {"epoch": self._epoch, "cursor": self._cursor,
+                "shuffle_seed": self.shuffle_seed}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        seed = state.get("shuffle_seed", self.shuffle_seed)
+        if seed != self.shuffle_seed:
+            raise ValueError(
+                f"stream state was saved under shuffle_seed {seed}, this "
+                f"dataset uses {self.shuffle_seed}: epoch permutations differ")
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+
+    def _advance(self) -> None:
+        """Move the host-side position one batch forward — the exact wrap
+        rule of the C reader's fill_batch (epoch check BEFORE each row, so a
+        non-dividing batch carries its remainder into the next epoch)."""
+        for _ in range(self.batch_size):
+            if self._cursor >= self._per_rank:
+                self._cursor = 0
+                self._epoch += 1
+            self._cursor += 1
+        self.batches_served += 1
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if self._lib is not None:
@@ -157,7 +204,8 @@ class TokenShardDataset:
             *[p.encode() for p in self.paths])
         handle = lib.tsr_open(c_paths, len(self.paths), self.seq_len,
                               self.batch_size, self._native_seed,
-                              self.rank, self.world_size)
+                              self.rank, self.world_size,
+                              self._epoch, self._cursor)
         if not handle:
             raise RuntimeError(f"tsr_open failed for {self.paths}")
         out = np.empty((self.batch_size, self.seq_len), np.int32)
@@ -167,6 +215,7 @@ class TokenShardDataset:
                     handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
                 if rc != 0:
                     return
+                self._advance()
                 yield self._to_batch(out.copy())
         finally:
             lib.tsr_close(handle)
@@ -205,7 +254,7 @@ class TokenShardDataset:
         if per_rank == 0:
             raise ValueError(
                 f"{total} sequences cannot shard across {self.world_size} ranks")
-        epoch, cursor = 0, 0
+        epoch, cursor = self._epoch, self._cursor  # resume point (O(1) seek)
         order = make_order(epoch)
         while True:
             ids = np.empty((self.batch_size, self.seq_len), np.int32)
@@ -215,4 +264,5 @@ class TokenShardDataset:
                     order = make_order(epoch)
                 ids[row] = lookup(int(order[cursor * self.world_size + self.rank]))
                 cursor += 1
+            self._advance()
             yield self._to_batch(ids)

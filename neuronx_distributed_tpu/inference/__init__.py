@@ -1,7 +1,15 @@
 """Inference stack (reference ``trace/`` + ``examples/inference/modules``;
 SURVEY §3.5): AOT builder with shape router, KV-cached CausalLM serving,
-samplers. Speculative decoding in ``speculative.py``."""
+samplers, the continuous-batching engine (``engine.py``). Speculative
+decoding in ``speculative.py``."""
 
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult  # noqa: F401
+from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
+    Completion,
+    Request,
+    ServeEngine,
+    run_trace,
+    synthetic_trace,
+)
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
-from neuronx_distributed_tpu.inference.sampling import Sampler  # noqa: F401
+from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler  # noqa: F401

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuronx_distributed_tpu.inference.sampling import Sampler
+from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
 PyTree = Any
 
@@ -44,9 +44,11 @@ def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
 
 def _merge_cache_slots(old: PyTree, new: PyTree, sel: jax.Array,
                        new_len: jax.Array) -> PyTree:
-    """Per-slot cache merge: selected batch rows take the freshly prefilled
-    state (KV rows + their true prompt lengths), unselected rows keep their
-    in-flight state. Cache leaves are layer-stacked with batch at axis 1."""
+    """Full-width cache merge (the pre-scatter insert path, kept as the
+    bench comparison baseline): selected batch rows take the freshly
+    prefilled state, unselected rows keep their in-flight state. The
+    ``jnp.where`` copies EVERY cache byte — O(cache) HBM traffic per insert,
+    which is what ``_scatter_cache_rows`` replaces with O(inserted rows)."""
 
     def merge(path, o, n):
         if jax.tree_util.keystr(path).endswith("['cache_index']"):
@@ -55,6 +57,28 @@ def _merge_cache_slots(old: PyTree, new: PyTree, sel: jax.Array,
         return jnp.where(sel.reshape(shape), n, o)
 
     return jax.tree_util.tree_map_with_path(merge, old, new)
+
+
+def _scatter_cache_rows(old: PyTree, fresh: PyTree, slots: jax.Array,
+                        new_len: jax.Array, rows: int) -> PyTree:
+    """Scatter ``rows`` freshly prefilled cache rows into the session cache
+    at ``slots`` via per-slot ``dynamic_update_slice`` — HBM traffic scales
+    with the INSERTED rows, not the whole cache (cache leaves are
+    layer-stacked with batch at axis 1; ``fresh`` was prefilled at batch
+    width ``rows``). ``cache_index`` rows take the true prompt lengths."""
+
+    def upd(path, o, f):
+        if jax.tree_util.keystr(path).endswith("['cache_index']"):
+            for i in range(rows):
+                v = jnp.broadcast_to(new_len[i].astype(o.dtype), (o.shape[0], 1))
+                o = jax.lax.dynamic_update_slice_in_dim(o, v, slots[i], axis=1)
+            return o
+        for i in range(rows):
+            o = jax.lax.dynamic_update_slice_in_dim(
+                o, jax.lax.dynamic_slice_in_dim(f, i, 1, axis=1), slots[i], axis=1)
+        return o
+
+    return jax.tree_util.tree_map_with_path(upd, old, fresh)
 
 
 def infer_prompt_lengths(prompt_ids: np.ndarray, pad_token_id: int = 0) -> np.ndarray:
@@ -129,6 +153,9 @@ class CausalLM:
         self._prefill = {}
         self._decode = None
         self._decode_fused = {}
+        self._session_fused = {}
+        self._insert_prefill = {}   # (rows, bucket) -> right-sized prefill
+        self._insert_scatter = {}   # rows -> donated row-scatter program
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
@@ -224,14 +251,7 @@ class CausalLM:
                 body, (cache, tok, rng, done), None, length=steps)
             return toks, cache, tok, rng, done
 
-        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
-
-        def prefill_shape(params, ids):
-            _, mut = self.model.apply({"params": self._resolve(params)}, ids,
-                                      mutable=["cache"])
-            return mut["cache"]
-
-        cache0 = jax.eval_shape(prefill_shape, self.params, ids0)
+        cache0 = self._cache_avals()
         tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
         done0 = jnp.zeros((self.max_batch,), bool)
         self._decode_fused[key] = (
@@ -239,6 +259,95 @@ class CausalLM:
             .lower(self.params, cache0, tok0, jax.random.key(0), done0).compile()
         )
         return self._decode_fused[key]
+
+    def _cache_avals(self) -> PyTree:
+        """Abstract KV-cache structure at session width (max_batch) — enough
+        to lower cache-carrying programs without executing a prefill."""
+        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+
+        def prefill_shape(params, ids):
+            _, mut = self.model.apply({"params": self._resolve(params)}, ids,
+                                      mutable=["cache"])
+            return mut["cache"]
+
+        return jax.eval_shape(prefill_shape, self.params, ids0)
+
+    def compile_session_decode_fused(self, steps: int,
+                                     slot_sampler: Optional[SlotSampler] = None,
+                                     pad_token_id: int = 0):
+        """Compile ``steps`` continuous-batching decode iterations as ONE
+        device program — the session counterpart of
+        :meth:`compile_decode_fused`, with the per-slot serving state carried
+        ON-DEVICE so the whole slot pool advances K tokens per dispatch.
+
+        The scan body carries ``(cache, tok, rng, lengths, done)`` and closes
+        over the block-invariant ``active``/``eos_ids``/``temperature``/
+        ``greedy`` row arrays (membership and per-request samplers change
+        only at block boundaries, where the scheduler passes refreshed
+        arrays — they ride the dispatch, costing no extra host op):
+
+        * emission: the token emitted at step i is frozen to ``pad_token_id``
+          for rows that were done OR inactive BEFORE step i (the stepwise
+          engine's record order); the raw sample still feeds step i+1,
+          matching step decode exactly;
+        * per-token EOS: ``done`` latches when an active row samples its own
+          ``eos_ids`` entry (−1 disables — per-REQUEST eos ids ride a device
+          array instead of forcing a recompile per id mix);
+        * overflow guard: an active row whose next write would run past
+          ``max_seq_len`` latches ``done`` — its later emissions pad and the
+          (dropped) cache writes can never wrap onto a neighbour. The
+          scheduler prevents this at admission; the latch makes the device
+          program safe even against a buggy/hostile driver.
+
+        Every latch is a pure function of the EMITTED tokens and the block
+        inputs, so a host scheduler can mirror ``lengths``/``done`` exactly
+        from the single per-block fetch — one program call + one fetch per K
+        tokens for the whole pool.
+
+        Returns the compiled program ``(params, cache, tok (b,1), rng,
+        lengths (b,), active (b,), done (b,), eos_ids (b,), temperature (b,),
+        greedy (b,)) -> (tokens (steps, b), cache, next_tok, rng, lengths,
+        done)``. Cached per ``(steps, slot_sampler, pad)``.
+        """
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        slot_sampler = slot_sampler or SlotSampler()
+        key = (steps, slot_sampler, pad_token_id)
+        if key in self._session_fused:
+            return self._session_fused[key]
+        max_len = self.config.max_seq_len
+
+        def fused_fn(params, cache, tok, rng, lengths, active, done,
+                     eos_ids, temperature, greedy):
+            def body(carry, _):
+                cache, tok, rng, lengths, done = carry
+                rng, sub = jax.random.split(rng)
+                logits, mut = self.model.apply(
+                    {"params": self._resolve(params), "cache": cache}, tok,
+                    mutable=["cache"]
+                )
+                nxt = slot_sampler(logits[:, 0, :], sub, temperature, greedy)
+                out = jnp.where(done | ~active, jnp.int32(pad_token_id), nxt)
+                done = done | (active & (eos_ids >= 0) & (nxt == eos_ids))
+                lengths = lengths + 1
+                done = done | (active & (lengths + 1 >= max_len))
+                return (mut["cache"], nxt[:, None], rng, lengths, done), out
+
+            (cache, tok, rng, lengths, done), toks = jax.lax.scan(
+                body, (cache, tok, rng, lengths, done), None, length=steps)
+            return toks, cache, tok, rng, lengths, done
+
+        b = self.max_batch
+        self._session_fused[key] = (
+            jax.jit(fused_fn, donate_argnums=(1,))
+            .lower(self.params, self._cache_avals(),
+                   jnp.zeros((b, 1), jnp.int32), jax.random.key(0),
+                   jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+                   jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
+                   jnp.ones((b,), jnp.float32), jnp.ones((b,), bool))
+            .compile()
+        )
+        return self._session_fused[key]
 
     def _bucket_for(self, s: int) -> int:
         for b in self.buckets:
@@ -259,14 +368,7 @@ class CausalLM:
         keep their own overflow guards."""
         if self._decode is None:
             self.compile()
-        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
-
-        def prefill_shape(params, ids):
-            _, mut = self.model.apply({"params": self._resolve(params)}, ids,
-                                      mutable=["cache"])
-            return mut["cache"]
-
-        cache = jax.eval_shape(prefill_shape, self.params, ids0)
+        cache = self._cache_avals()
         return DecodeSession(
             cache=jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache),
             lengths=np.zeros((self.max_batch,), np.int64),
@@ -284,11 +386,46 @@ class CausalLM:
                 f"slot ids {slot_ids.tolist()} out of range [0, {self.max_batch})"
             )
 
+    def _insert_programs(self, rows: int, bucket: int):
+        """Lazily compile the RIGHT-SIZED insert pair for ``rows`` inserted
+        prompts: a prefill at batch width ``rows`` (prefill FLOPs scale with
+        what was actually inserted, not ``max_batch``) and a donated
+        row-scatter into the session cache (O(rows) HBM traffic — the
+        full-cache ``jnp.where`` merge it replaces copies every cache byte
+        per insert)."""
+        pkey = (rows, bucket)
+        if pkey not in self._insert_prefill:
+            if rows == self.max_batch and bucket in self._prefill:
+                self._insert_prefill[pkey] = self._prefill[bucket]
+            else:
+                def prefill_fn(params, ids):
+                    logits, mut = self.model.apply(
+                        {"params": self._resolve(params)}, ids, mutable=["cache"])
+                    return logits, mut["cache"]
+
+                ids0 = jnp.zeros((rows, bucket), jnp.int32)
+                self._insert_prefill[pkey] = (
+                    jax.jit(prefill_fn).lower(self.params, ids0).compile())
+        if rows not in self._insert_scatter:
+            self._insert_scatter[rows] = jax.jit(
+                lambda old, fresh, slots, new_len: _scatter_cache_rows(
+                    old, fresh, slots, new_len, rows),
+                donate_argnums=(0,),
+            )
+        return self._insert_prefill[pkey], self._insert_scatter[rows]
+
     def insert(self, session: "DecodeSession", slot_ids: np.ndarray,
                prompt_ids: np.ndarray, lengths: Optional[np.ndarray] = None,
                pad_token_id: int = 0) -> jax.Array:
         """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
         rows and lengths are preserved (they may be mid-generation).
+
+        Right-sized: only the inserted rows are prefilled — at their own
+        batch width — and scattered into the session cache with per-slot
+        ``dynamic_update_slice``, so both the prefill FLOPs and the cache
+        HBM traffic scale with ``len(slot_ids)``, not ``max_batch`` (the
+        reference prefills its full CTX batch per insert; the old path here
+        did too, plus a whole-cache ``jnp.where`` copy).
         Returns ``next_token_logits (len(slot_ids), vocab)``."""
         if self._decode is None:
             self.compile()
@@ -306,19 +443,17 @@ class CausalLM:
                 f"max_seq_len {self.config.max_seq_len}"
             )
         bucket = self._bucket_for(s)
-        ids = np.zeros((self.max_batch, bucket), np.int32)
-        ids[slot_ids, :s] = prompt_ids
-        logits, fresh = self._prefill[bucket](self.params, jnp.asarray(ids))
-        sel = np.zeros((self.max_batch,), bool)
-        sel[slot_ids] = True
-        new_len = np.zeros((self.max_batch,), np.int32)
-        new_len[slot_ids] = lengths
-        session.cache = _merge_cache_slots(session.cache, fresh, jnp.asarray(sel),
-                                           jnp.asarray(new_len))
+        rows = len(slot_ids)
+        prefill, scatter = self._insert_programs(rows, bucket)
+        ids = np.zeros((rows, bucket), np.int32)
+        ids[:, :s] = prompt_ids
+        logits, fresh = prefill(self.params, jnp.asarray(ids))
+        session.cache = scatter(session.cache, fresh,
+                                jnp.asarray(slot_ids), jnp.asarray(lengths))
         session.lengths[slot_ids] = lengths
         session.active[slot_ids] = True
         last = jnp.asarray(np.maximum(lengths - 1, 0))
-        return logits[jnp.asarray(slot_ids), last]
+        return logits[jnp.arange(rows), last]
 
     def step(self, session: "DecodeSession", tokens: np.ndarray) -> jax.Array:
         """One decode step for ALL slots (inactive slots advance harmlessly —
@@ -431,9 +566,14 @@ class CausalLM:
         finished = record(tok_np, 0)
         t = 1
         while t < max_new_tokens and not finished:
-            if use_fused and max_new_tokens - t >= fused_chunk:
+            # full chunks, then ONE tail-sized fused program for the
+            # remainder (cached per size): short tails keep the dispatch
+            # amortization instead of silently falling back to per-token
+            # step decode; only a 1-token tail uses the step program
+            k = min(fused_chunk, max_new_tokens - t) if use_fused else 1
+            if k > 1:
                 fused = self.compile_decode_fused(
-                    fused_chunk, sampler, eos_token_id, pad_token_id)
+                    k, sampler, eos_token_id, pad_token_id)
                 toks, cache, next_tok, rng, _ = fused(
                     self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32),
                     rng, jnp.asarray(done))

@@ -42,3 +42,35 @@ class Sampler:
             cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotSampler:
+    """Per-slot sampler for the continuous-batching engine: each batch row
+    (cache slot) carries its own greedy flag and temperature as DEVICE
+    arrays, so one compiled program serves a mixed pool of requests (the
+    per-request sampler knob a multi-tenant scheduler needs without a
+    recompile per mix). ``top_k``/``top_p`` stay static — they change
+    compiled shapes/ops, so they are engine-wide and the scheduler validates
+    per-request samplers against them at admission.
+
+    Row math is IDENTICAL to :class:`Sampler` at the same settings (greedy
+    row == ``Sampler(greedy=True)``, sampled row == ``Sampler(temperature=t,
+    top_k, top_p)``) and rows are independent under one categorical key, so
+    a request's token stream does not depend on what its slot neighbours
+    sample."""
+
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+    def __call__(self, logits: jax.Array, key: jax.Array,
+                 temperature: jax.Array, greedy: jax.Array) -> jax.Array:
+        """logits (b, vocab), temperature (b,) f32, greedy (b,) bool -> (b,)."""
+        base = Sampler(top_k=self.top_k, top_p=self.top_p)
+        logits = logits.astype(jnp.float32)
+        arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # temperature 0 rows route to argmax; the guard only keeps the
+        # sampled branch finite for them (its result is discarded)
+        safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = base(logits / safe_t, key)
+        return jnp.where(greedy | (temperature <= 0.0), arg, sampled)

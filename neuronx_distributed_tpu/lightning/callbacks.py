@@ -50,8 +50,13 @@ class ModelCheckpoint(Callback):
     def _save(self, trainer, step: int) -> None:
         from neuronx_distributed_tpu.checkpoint import save_checkpoint
 
+        content = {"step": step}
+        if getattr(trainer, "train_stream", None) is not None:
+            # data-stream position rides the checkpoint so resume seeks the
+            # stream in O(1) instead of replaying next() step times
+            content["data_state"] = trainer.train_stream.state_dict()
         save_checkpoint(self.checkpoint_dir, f"step_{step}", trainer.state,
-                        user_content={"step": step}, async_save=self.async_save,
+                        user_content=content, async_save=self.async_save,
                         num_kept=self.num_kept)
 
 

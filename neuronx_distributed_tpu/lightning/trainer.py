@@ -54,6 +54,7 @@ class NxDTrainer:
         self.model = None
         self.optimizer = None
         self.state = None
+        self.train_stream = None   # restorable data stream, set by fit()
 
     # --- loop ------------------------------------------------------------
 
@@ -63,12 +64,20 @@ class NxDTrainer:
         train_batches: Iterator[Dict[str, Any]],
         val_batches: Optional[Iterator[Dict[str, Any]]] = None,
     ):
-        sample = next(train_batches)
+        # a restorable stream (state_dict/load_state_dict — TokenShardDataset)
+        # gets its position checkpointed WITH the model and seeked in O(1) on
+        # resume; plain iterators fall back to the O(steps) replay below
+        restorable = (hasattr(train_batches, "state_dict")
+                      and hasattr(train_batches, "load_state_dict"))
+        self.train_stream = train_batches if restorable else None
+        stream_it = iter(train_batches)
+        sample = next(stream_it)
         self.model = initialize_parallel_model(
             module.nxd_config, module.configure_model, *module.model_inputs(sample)
         )
         self.optimizer = module.configure_optimizer(self.model)
         self.state = create_train_state(self.model, self.optimizer)
+        content = None
         if self.checkpoint_dir and has_checkpoint(self.checkpoint_dir):
             self.state, content = load_checkpoint(self.checkpoint_dir,
                                                   target=self.state)
@@ -91,14 +100,23 @@ class NxDTrainer:
         start = int(self.state.step)
         # Batch alignment: step i+1 trains the stream's i-th batch. The init
         # sample IS batch 0 (re-queued on fresh runs); a resumed run must
-        # skip forward so global step <-> batch pairing matches a straight
-        # run exactly (assumes a restartable deterministic stream, like the
-        # reference's set_seed + sampler-state discipline).
+        # move the stream forward so global step <-> batch pairing matches a
+        # straight run exactly. A restorable stream SEEKS there in O(1) from
+        # the checkpointed position (ROADMAP #7 — no O(steps) next() replay,
+        # which at production step counts replays the whole history through
+        # the loader); plain iterators replay (assumes a restartable
+        # deterministic stream, the reference's set_seed + sampler-state
+        # discipline).
         pending: Optional[Dict[str, Any]] = sample if start == 0 else None
-        for _ in range(max(start - 1, 0)):
-            next(train_batches)
+        if start > 0 and self.train_stream is not None \
+                and content and "data_state" in content:
+            self.train_stream.load_state_dict(content["data_state"])
+            stream_it = iter(self.train_stream)   # re-enter AT the position
+        else:
+            for _ in range(max(start - 1, 0)):
+                next(stream_it)
         for i in range(start, self.max_steps):
-            batch = pending if pending is not None else next(train_batches)
+            batch = pending if pending is not None else next(stream_it)
             pending = None
             with step_annotation(i):
                 self.state, metrics = step_fn(self.state, batch,

@@ -42,6 +42,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
     monkeypatch.setattr(bench, "bench_speculation",
                         lambda **kw: {"spec_round_device_ms": 40.0,
                                       "spec_speedup_fused_int8draft2L": 1.42})
+    monkeypatch.setattr(bench, "bench_serving",
+                        lambda **kw: {"serve_tokens_per_sec_cb": 512.0,
+                                      "serve_insert_ms_1slot": 21.0,
+                                      "serve_insert_fullwidth_ms_1slot": 60.0,
+                                      "serve_fused_round_device_ms": 130.0,
+                                      "serve_fused_vs_generate_fused16": 1.05})
     import neuronx_distributed_tpu.utils.cp_microbench as cpm
     monkeypatch.setattr(cpm, "measure_cp_ratio_isolated", lambda *a, **kw: {
         "cp_vs_sp_throughput": 0.97, "cp_vs_sp_throughput_ici_serial": 0.95,
@@ -75,6 +81,11 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     # at the sidecar; long keys (unit, per-depth dicts) stay out of it
     assert h["value"] == d["value"] and h["vs_baseline"] == d["vs_baseline"]
     assert h["spec_speedup_fused_int8draft2L"] == 1.42
+    # serving keys (ISSUE 2) ride both surfaces
+    assert d["serve_tokens_per_sec_cb"] == h["serve_tokens_per_sec_cb"] == 512.0
+    assert h["serve_insert_ms_1slot"] == 21.0
+    assert h["serve_insert_ms_1slot"] < h["serve_insert_fullwidth_ms_1slot"]
+    assert h["serve_fused_round_device_ms"] == 130.0
     assert h["full_report"] == "BENCH_REPORT.json"
     assert "unit" not in h and "train_step_time_s_measured" not in h
     assert len(json.dumps(h)) < 1900, "headline must survive a 2000-byte tail"

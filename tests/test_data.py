@@ -107,7 +107,7 @@ def test_corrupt_num_seqs_rejected(tmp_path):
 
     lib = _load_native()
     c_paths = (ctypes.c_char_p * 1)(p.encode())
-    handle = lib.tsr_open(c_paths, 1, 16, 2, 0, 0, 1)
+    handle = lib.tsr_open(c_paths, 1, 16, 2, 0, 0, 1, 0, 0)
     assert not handle  # rejected cleanly
 
 
@@ -151,3 +151,37 @@ def test_bad_rank_world_rejected(shards):
         TokenShardDataset(paths, batch_size=2, rank=2, world_size=2)
     with pytest.raises(ValueError):
         TokenShardDataset(paths, batch_size=2, rank=0, world_size=0)
+
+
+@pytest.mark.parametrize("native", [False, pytest.param(True, marks=needs_gxx)])
+def test_stream_state_resume_o1(shards, native):
+    """state_dict/load_state_dict (ROADMAP #7): a fresh dataset restored from
+    a saved (epoch, cursor) continues the stream bit-identically — including
+    across an epoch boundary with a non-dividing batch size — WITHOUT
+    replaying the consumed prefix."""
+    paths, _ = shards
+    ds = TokenShardDataset(paths, batch_size=5, shuffle_seed=3, native=native)
+    it = iter(ds)
+    for _ in range(4):   # 20 rows consumed of a 16-row epoch -> epoch 1
+        next(it)
+    sd = ds.state_dict()
+    assert sd["epoch"] == 1 and ds.batches_served == 4
+    cont = [next(it)["ids"].copy() for _ in range(4)]
+
+    ds2 = TokenShardDataset(paths, batch_size=5, shuffle_seed=3, native=native)
+    ds2.load_state_dict(sd)
+    cont2 = []
+    it2 = iter(ds2)
+    for _ in range(4):
+        cont2.append(next(it2)["ids"].copy())
+    for a, b in zip(cont, cont2):
+        np.testing.assert_array_equal(a, b)
+    # O(1): only the continuation was served, nothing replayed
+    assert ds2.batches_served == 4
+
+
+def test_stream_state_seed_mismatch_rejected(shards):
+    paths, _ = shards
+    ds = TokenShardDataset(paths, batch_size=4, shuffle_seed=3, native=False)
+    with pytest.raises(ValueError, match="shuffle_seed"):
+        ds.load_state_dict({"epoch": 0, "cursor": 4, "shuffle_seed": 9})

@@ -141,6 +141,42 @@ def test_inference_runner_benchmark_fused(capsys):
     assert step_out == fused_out
 
 
+def test_inference_runner_serve_tiny(capsys):
+    """Fast CPU smoke for the continuous-batching entrypoint: runner.py
+    serve drives ServeEngine over a synthetic arrival trace and reports the
+    throughput/host-op surface (the fused dispatch contract rides tier-1)."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["fused"] is True and report["block_steps"] == 3
+    assert report["host_ops_per_block"] == 2.0
+    assert report["tokens_per_sec"] > 0
+
+
+@pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
+# fast smoke above
+def test_inference_runner_serve_stepwise_matches_fused(capsys):
+    """--stepwise replays the same trace per-token: identical completion
+    counts, ~K-fold more host ops (the dispatch amortization the fused
+    engine exists for)."""
+    import runner
+
+    args = ["serve", "--tiny", "--max_batch", "2", "--num_requests", "6",
+            "--max_new_tokens", "8", "--fused_steps", "4"]
+    runner.main(args)
+    fused = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    runner.main(args + ["--stepwise"])
+    step = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert fused["requests_completed"] == step["requests_completed"] == 6
+    assert fused["total_generated_tokens"] == step["total_generated_tokens"]
+    assert fused["host_ops_per_block"] == 2.0
+    assert step["host_ops_per_block"] == 8.0
+
+
 def test_mixtral_moe_tiny():
     import mixtral_moe
 

@@ -108,6 +108,44 @@ def test_tensorboard_logger_fallback(tmp_path):
     assert any("events" in f or f.endswith(".jsonl") for f in files), files
 
 
+def test_resume_restores_data_stream_state(tmp_path):
+    """ROADMAP #7: resume must SEEK the data stream from the checkpointed
+    (epoch, cursor) — O(1), not an O(steps) next() replay — and the resumed
+    run's params must equal a straight run's exactly (same batches at the
+    same global steps, through a real TokenShardDataset)."""
+    from neuronx_distributed_tpu.data import write_token_shard
+    from neuronx_distributed_tpu.data.loader import TokenShardDataset
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    rs = np.random.RandomState(0)
+    shard = str(tmp_path / "s0.bin")
+    write_token_shard(shard, rs.randint(0, 127, (10, 17)).astype(np.int32))
+    ck = str(tmp_path / "ck")
+
+    def make_ds():
+        return TokenShardDataset([shard], batch_size=4, shuffle_seed=7)
+
+    def run(max_steps, ckpt_dir=None):
+        cbs = ([ModelCheckpoint(ckpt_dir, every_n_steps=2, async_save=False)]
+               if ckpt_dir else [])
+        trainer = NxDTrainer(max_steps=max_steps, checkpoint_dir=ckpt_dir,
+                             callbacks=cbs)
+        ds = make_ds()
+        state, m = trainer.fit(TinyLlamaModule(), ds)
+        return jax.tree.map(np.asarray, state.params), ds, float(m["loss"])
+
+    straight, ds_s, loss_s = run(4)
+    assert ds_s.batches_served == 4          # batches 0..3
+    ps.destroy_model_parallel()
+    run(2, ck)
+    ps.destroy_model_parallel()
+    resumed, ds_r, loss_r = run(4, ck)
+    # O(1) seek: init sample (batch 0) + batches 2,3 — NOT a 4-batch replay
+    assert ds_r.batches_served == 3
+    assert loss_r == loss_s
+    jax.tree.map(np.testing.assert_array_equal, straight, resumed)
+
+
 def test_resume_batch_alignment(tmp_path):
     """Resumed fit must train the SAME batches at the same global steps as a
     straight run (r2 review: the init-consumed batch must not shift the

@@ -1079,6 +1079,87 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     out["serve_cb_basis"] = (
         "12-request exponential arrival trace, 128-tok prompts, 48 new "
         "tokens each, 4 slots, fused K=16; warmed wall clock incl. inserts")
+
+    # --- paged KV + shared-prefix reuse (ISSUE 3 tentpole evidence): the
+    # same weights behind a paged CausalLM. Three claims, measured:
+    # (a) prefix-hit TTFT (insert a prompt whose long prefix is cached ->
+    #     only the suffix prefills) vs cold TTFT, min-over-trials with a
+    #     FRESH prompt per cold trial so no trial accidentally hits;
+    # (b) HBM: pool bytes vs the slab at the same dims (sizing formula);
+    # (c) end-to-end paged engine throughput on a shared-prefix trace.
+    try:
+        page_size = 16
+        ppseq = (prompt_len + 256) // page_size
+        lm_p = CausalLM(lcfg, model.params, LlamaForCausalLM,
+                        buckets=(64, prompt_len), max_batch=max_batch,
+                        page_size=page_size,
+                        page_pool_pages=max_batch * ppseq // 2 + max_batch)
+        lm_p.compile()
+        kv = lm_p.kv_cache_bytes()
+        out["paged_hbm_bytes"] = kv["kv_bytes"]
+        out["paged_hbm_bytes_vs_slab"] = round(
+            kv["kv_bytes"] / kv["kv_slab_bytes"], 3)
+        out["serve_paged_page_size"] = page_size
+        psess = lm_p.start_session()
+        rs_p = np.random.RandomState(7)
+        shared = rs_p.randint(1, 32000, (prompt_len - page_size,)).astype(np.int32)
+
+        def paged_ttft(prompt):
+            t0 = time.perf_counter()
+            lg = lm_p.insert(psess, [0], prompt[None], reserve_tokens=64)
+            int(jnp.argmax(lg[0]))            # first token fetch = sync
+            dt = time.perf_counter() - t0
+            lm_p.retire(psess, [0])
+            return dt
+
+        # warm both insert programs (cold: full prompt_len bucket; hit: the
+        # 64-token suffix bucket) outside the timed trials
+        paged_ttft(rs_p.randint(1, 32000, (prompt_len,)).astype(np.int32))
+        warm_hit = np.concatenate([shared, rs_p.randint(
+            1, 32000, (page_size,)).astype(np.int32)])
+        paged_ttft(warm_hit)
+        cold_ts, hit_ts = [], []
+        for _ in range(6):
+            cold_ts.append(paged_ttft(
+                rs_p.randint(1, 32000, (prompt_len,)).astype(np.int32)))
+            hit_ts.append(paged_ttft(np.concatenate([
+                shared, rs_p.randint(1, 32000, (page_size,)).astype(np.int32)])))
+        out["serve_cold_ttft_ms"] = round(float(np.min(cold_ts)) * 1e3, 2)
+        out["serve_prefix_hit_ttft_ms"] = round(float(np.min(hit_ts)) * 1e3, 2)
+        out["serve_prefix_hit_ttft_ratio"] = round(
+            float(np.min(hit_ts)) / float(np.min(cold_ts)), 3)
+        out["serve_prefix_hit_tokens"] = psess.paged.stats["prefix_hit_tokens"]
+        out["serve_prefix_ttft_basis"] = (
+            f"1-slot insert + first-token fetch, min of 6 trials; hit "
+            f"prompts share a cached {prompt_len - page_size}-token prefix "
+            f"(suffix prefill = {page_size} tokens in a 64-bucket), cold "
+            f"prompts are fresh per trial")
+
+        # end-to-end paged engine throughput on the shared-prefix trace.
+        # Warm EVERY insert program the trace can hit — any admission-group
+        # width x either suffix bucket (cold prompts prefill the full 128
+        # bucket, prefix hits the 64 one) — plus the fused block, so no XLA
+        # compile lands inside the timed window
+        ptrace = synthetic_trace(
+            12, 32000, prompt_lens=(page_size,), max_new_tokens=48,
+            mean_interarrival_blocks=0.5,
+            shared_prefix_len=prompt_len - page_size, seed=0)
+        for rows in range(1, max_batch + 1):
+            for b in (64, prompt_len):
+                lm_p._paged_insert_programs(rows, b)
+        warm_p = ServeEngine(lm_p, block_steps=fused_steps)
+        for item in ptrace[:max_batch]:
+            warm_p.submit(item["prompt"], 2)
+        warm_p.run()
+        eng_p = ServeEngine(lm_p, block_steps=fused_steps)
+        rep_p = run_trace(eng_p, ptrace)
+        out["serve_tokens_per_sec_paged"] = rep_p["tokens_per_sec"]
+        out["serve_paged_prefix_hit_tokens_trace"] = rep_p["prefix_hit_tokens"]
+        out["serve_paged_host_ops_per_block"] = rep_p["host_ops_per_block"]
+        del lm_p, psess, warm_p, eng_p
+    except Exception as e:  # noqa: BLE001 — paged section additive, never fatal
+        out["serve_paged_error"] = f"{type(e).__name__}: {e}"[:120]
+
     del lm, model, session, fused, st, cache
     gc.collect()
     return out
@@ -1104,8 +1185,35 @@ HEADLINE_KEYS = (
     "serve_tokens_per_sec_cb", "serve_insert_ms_1slot", "serve_insert_ms_4slot",
     "serve_insert_fullwidth_ms_1slot", "serve_fused_round_device_ms",
     "serve_fused_ms_per_token", "serve_fused_vs_generate_fused16",
-    "ttft_error", "spec_bench_error", "serve_bench_error",
+    "serve_cold_ttft_ms", "serve_prefix_hit_ttft_ms",
+    "serve_prefix_hit_ttft_ratio", "paged_hbm_bytes_vs_slab",
+    "serve_tokens_per_sec_paged",
+    "ttft_error", "spec_bench_error", "serve_bench_error", "serve_paged_error",
 )
+
+
+def runtime_env() -> dict:
+    """jax/jaxlib versions + active XLA/runtime flags, recorded in the
+    BENCH_REPORT.json sidecar so PROFILE.md's machine-state caveats are
+    machine-checkable across runs (two rounds' numbers are only comparable
+    when these match). Sidecar-only — never a headline key."""
+    import os
+
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001
+        jaxlib_version = None
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "backend": jax.default_backend(),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS", ""),
+        "jax_enable_x64": bool(jax.config.jax_enable_x64),
+        "jax_disable_most_optimizations": bool(
+            getattr(jax.config, "jax_disable_most_optimizations", False)),
+    }
 
 
 def emit_report(report: dict) -> None:
@@ -1117,6 +1225,7 @@ def emit_report(report: dict) -> None:
 
     path = os.environ.get("BENCH_REPORT_PATH") or str(
         Path(__file__).resolve().with_name("BENCH_REPORT.json"))
+    report = {**report, "env": runtime_env()}
     try:
         with open(path, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)

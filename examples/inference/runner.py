@@ -142,8 +142,16 @@ def build_model(args):
         from neuronx_distributed_tpu.quantization.core import quantize_params
 
         params = quantize_params(params)
+    paged_kw = {}
+    if getattr(args, "paged", False):
+        if args.cmd != "serve":
+            raise SystemExit("--paged applies to the serve subcommand only "
+                             "(generate/benchmark run the contiguous path)")
+        paged_kw = dict(page_size=args.page_size,
+                        page_pool_pages=args.page_pool_pages or None,
+                        prefix_cache=not args.no_prefix_cache)
     lm = CausalLM(cfg, params, _model_cls(args),
-                  buckets=buckets, max_batch=args.max_batch)
+                  buckets=buckets, max_batch=args.max_batch, **paged_kw)
     return lm, cfg
 
 
@@ -399,13 +407,17 @@ def cmd_serve(args) -> None:
         args.num_requests, cfg.vocab_size, prompt_lens=prompt_lens,
         max_new_tokens=args.max_new_tokens,
         mean_interarrival_blocks=args.mean_interarrival,
+        shared_prefix_len=args.shared_prefix_len,
         seed=args.seed,
     )
     # warm every program the trace will hit (all insert widths per bucket +
-    # the fused block) OUTSIDE the timed window — cmd_generate's discipline
-    for s in sorted({len(item["prompt"]) for item in trace}):
-        for rows in range(1, lm.max_batch + 1):
-            lm._insert_programs(rows, lm._bucket_for(s))
+    # the fused block) OUTSIDE the timed window — cmd_generate's discipline.
+    # Paged mode compiles its insert programs lazily per suffix width; the
+    # warm engine run below covers the widths the trace produces.
+    if not lm.paged:
+        for s in sorted({len(item["prompt"]) for item in trace}):
+            for rows in range(1, lm.max_batch + 1):
+                lm._insert_programs(rows, lm._bucket_for(s))
     warm = ServeEngine(lm, block_steps=args.fused_steps,
                        fused=not args.stepwise, rng=jax.random.key(args.seed))
     for item in trace[: min(len(trace), lm.max_batch)]:
@@ -558,6 +570,23 @@ def main(argv=None) -> None:
         p.add_argument("--mean_interarrival", type=float, default=0.5,
                        help="serve: mean request inter-arrival time in "
                             "decode blocks (exponential)")
+        p.add_argument("--paged", action="store_true",
+                       help="serve: paged KV cache (block-table page pool + "
+                            "shared-prefix reuse instead of the slot slab)")
+        p.add_argument("--page_size", type=int, default=16,
+                       help="serve --paged: tokens per KV page (must divide "
+                            "max_seq_len)")
+        p.add_argument("--page_pool_pages", type=int, default=0,
+                       help="serve --paged: per-layer pool size in pages "
+                            "(0 = slab parity; smaller = the HBM win, "
+                            "admission defers under pool pressure)")
+        p.add_argument("--no_prefix_cache", action="store_true",
+                       help="serve --paged: disable the radix prefix index "
+                            "(pages still pooled, no cross-request sharing)")
+        p.add_argument("--shared_prefix_len", type=int, default=0,
+                       help="serve: prepend one common random prefix of this "
+                            "many tokens to every trace prompt (the "
+                            "prefix-cache workload shape)")
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")
         p.add_argument("--model", choices=["llama", "mixtral", "dbrx"],

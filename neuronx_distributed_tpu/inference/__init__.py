@@ -12,4 +12,10 @@ from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     synthetic_trace,
 )
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
+from neuronx_distributed_tpu.inference.paged_cache import (  # noqa: F401
+    PageAllocator,
+    PagedKVCache,
+    PagePoolExhausted,
+    RadixPrefixIndex,
+)
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler  # noqa: F401

@@ -25,9 +25,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuronx_distributed_tpu.inference.paged_cache import PagedKVCache
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
 PyTree = Any
+
+
+def _set_block_tables(cache: PyTree, tables) -> PyTree:
+    """Overwrite every per-layer block_table leaf (stacked (L, b, ppseq))
+    with the host allocator's current tables — the ONLY cache leaves the
+    host ever writes between blocks in paged mode (the pool itself moves
+    exclusively through donated device programs)."""
+    t = jnp.asarray(tables, jnp.int32)
+
+    def fix(path, leaf):
+        if jax.tree_util.keystr(path).endswith("['block_table']"):
+            return jnp.broadcast_to(t, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
 
 
 def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
@@ -116,6 +132,9 @@ class DecodeSession:
     cache: PyTree
     lengths: np.ndarray         # (max_batch,) tokens written per slot
     active: np.ndarray          # (max_batch,) slot in use
+    # paged mode: the host half of the paged pool (block tables, free-list
+    # allocator, radix prefix index) — None on contiguous-slab sessions
+    paged: Optional[PagedKVCache] = None
 
 
 class CausalLM:
@@ -131,6 +150,9 @@ class CausalLM:
         buckets: Tuple[int, ...] = (128, 512, 2048),
         max_batch: int = 4,
         param_transform=None,
+        page_size: Optional[int] = None,
+        page_pool_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
         # keep the caller's use_flash_attention: prefill buckets >= 128 run
         # the Pallas kernel with position masks (reference prefill gating,
@@ -138,6 +160,22 @@ class CausalLM:
         self.config = dataclasses.replace(
             config, decode=True, sequence_parallel=False, remat_policy=None,
         )
+        # paged KV mode: per-layer page pools + block-table sessions
+        # (inference/paged_cache.py). The pool defaults to slab parity plus
+        # the per-slot scratch pages; pass a smaller pool for the HBM win —
+        # admission then defers under pool pressure instead of OOMing.
+        self.paged = bool(page_size)
+        self.prefix_cache = bool(prefix_cache)
+        if self.paged:
+            if self.config.max_seq_len % page_size:
+                raise ValueError(
+                    f"page_size {page_size} must divide max_seq_len "
+                    f"{self.config.max_seq_len}")
+            pool = page_pool_pages or (
+                max_batch * (self.config.max_seq_len // page_size) + max_batch)
+            self.config = dataclasses.replace(
+                self.config, page_size=int(page_size),
+                page_pool_pages=int(pool))
         self.params = params
         self.max_batch = max_batch
         # applied INSIDE every compiled program (e.g. int8 dequantization —
@@ -156,6 +194,7 @@ class CausalLM:
         self._session_fused = {}
         self._insert_prefill = {}   # (rows, bucket) -> right-sized prefill
         self._insert_scatter = {}   # rows -> donated row-scatter program
+        self._paged_insert = {}     # (rows, bucket) -> donated paged insert
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
@@ -165,25 +204,37 @@ class CausalLM:
         return self.param_transform(params) if self.param_transform else params
 
     def compile(self) -> "CausalLM":
+        # every cache a program RETURNS is pinned replicated (_replicate_out,
+        # no-op off-mesh): session caches round-trip between AOT programs
+        # whose cache inputs are lowered replicated (_cache_avals) — an
+        # unconstrained output lets GSPMD hand back a sharded cache that the
+        # next call then rejects (batch-over-'edp' whenever max_batch
+        # divides it; trace-shape-dependent, so it bit only some schedules)
         def prefill_fn(params, ids):
             logits, mut = self.model.apply({"params": self._resolve(params)}, ids,
                                            mutable=["cache"])
-            return logits, mut["cache"]
+            return logits, self._replicate_out(mut["cache"])
 
         def decode_fn(params, cache, ids):
             logits, mut = self.model.apply(
                 {"params": self._resolve(params), "cache": cache}, ids,
                 mutable=["cache"]
             )
-            return logits, mut["cache"]
+            return logits, self._replicate_out(mut["cache"])
 
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
-        for bucket in self.buckets:
-            ids = jnp.zeros((self.max_batch, bucket), jnp.int32)
-            self._prefill[bucket] = jax.jit(prefill_fn).lower(self.params, ids).compile()
+        if not self.paged:
+            # paged mode never runs the stand-alone prefill (its cache init
+            # would alias every slot onto page 0): all prefill goes through
+            # the pool-donating insert programs, compiled lazily per width
+            for bucket in self.buckets:
+                ids = jnp.zeros((self.max_batch, bucket), jnp.int32)
+                self._prefill[bucket] = (
+                    jax.jit(prefill_fn).lower(self.params, ids).compile())
         # decode: donate the cache (argnum 1). Abstract cache avals suffice
-        # for lowering — no need to execute a real prefill at startup.
-        _, cache0 = jax.eval_shape(prefill_fn, self.params, ids0)
+        # for lowering — no need to execute a real prefill at startup
+        # (_cache_avals also pins them replicated under a mesh).
+        cache0 = self._cache_avals()
         tok = jnp.zeros((self.max_batch, 1), jnp.int32)
         self._decode = (
             jax.jit(decode_fn, donate_argnums=(1,)).lower(self.params, cache0, tok).compile()
@@ -249,7 +300,7 @@ class CausalLM:
 
             (cache, tok, rng, done), toks = jax.lax.scan(
                 body, (cache, tok, rng, done), None, length=steps)
-            return toks, cache, tok, rng, done
+            return toks, self._replicate_out(cache), tok, rng, done
 
         cache0 = self._cache_avals()
         tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
@@ -262,7 +313,11 @@ class CausalLM:
 
     def _cache_avals(self) -> PyTree:
         """Abstract KV-cache structure at session width (max_batch) — enough
-        to lower cache-carrying programs without executing a prefill."""
+        to lower cache-carrying programs without executing a prefill. When a
+        device mesh is active the avals are PINNED replicated: left
+        unannotated, GSPMD may assign the compiled program sharded cache
+        inputs (observed: batch over 'edp' whenever max_batch divides it),
+        which then reject the replicated session cache at call time."""
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
         def prefill_shape(params, ids):
@@ -270,7 +325,17 @@ class CausalLM:
                                       mutable=["cache"])
             return mut["cache"]
 
-        return jax.eval_shape(prefill_shape, self.params, ids0)
+        avals = jax.eval_shape(prefill_shape, self.params, ids0)
+        from neuronx_distributed_tpu.parallel import mesh as ps
+
+        if not ps.model_parallel_is_initialized():
+            return avals
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
+            avals)
 
     def compile_session_decode_fused(self, steps: int,
                                      slot_sampler: Optional[SlotSampler] = None,
@@ -335,7 +400,7 @@ class CausalLM:
 
             (cache, tok, rng, lengths, done), toks = jax.lax.scan(
                 body, (cache, tok, rng, lengths, done), None, length=steps)
-            return toks, cache, tok, rng, lengths, done
+            return toks, self._replicate_out(cache), tok, rng, lengths, done
 
         b = self.max_batch
         self._session_fused[key] = (
@@ -355,6 +420,28 @@ class CausalLM:
                 return b
         raise ValueError(f"prompt length {s} exceeds largest bucket {self.buckets[-1]}")
 
+    def kv_cache_bytes(self) -> dict:
+        """KV-cache HBM footprint of this serving config: ``kv_bytes`` is
+        what a session actually allocates (the page pools in paged mode, the
+        ``max_batch x max_seq_len`` slab otherwise); ``kv_slab_bytes`` is the
+        slab-equivalent for the same dims — the memory-sizing formula the
+        README documents (paged/slab = page_pool_pages*page_size /
+        (max_batch*max_seq_len))."""
+        actual = slab = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self._cache_avals())[0]:
+            p = jax.tree_util.keystr(path)
+            if not (p.endswith("['cached_key']") or p.endswith("['cached_value']")):
+                continue
+            nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            actual += nbytes
+            if self.paged:
+                tokens = self.config.page_pool_pages * self.config.page_size
+                slab += nbytes * (self.max_batch * self.config.max_seq_len) // tokens
+            else:
+                slab += nbytes
+        return {"kv_bytes": actual, "kv_slab_bytes": slab}
+
     # --- continuous batching (slot-level session API) --------------------
     # The reference reorders sequences into KV-cache slots via its seq_ids
     # machinery (model_wrapper.py:207); here the session object carries the
@@ -369,11 +456,19 @@ class CausalLM:
         if self._decode is None:
             self.compile()
         cache = self._cache_avals()
-        return DecodeSession(
+        session = DecodeSession(
             cache=jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache),
             lengths=np.zeros((self.max_batch,), np.int64),
             active=np.zeros((self.max_batch,), bool),
         )
+        if self.paged:
+            session.paged = PagedKVCache(
+                self.config.page_size, self.config.page_pool_pages,
+                self.max_batch, self.config.max_seq_len,
+                prefix_cache=self.prefix_cache)
+            session.cache = _set_block_tables(session.cache,
+                                              session.paged.tables)
+        return session
 
     def _check_slots(self, slot_ids: np.ndarray) -> None:
         if len(slot_ids) == 0:
@@ -407,16 +502,152 @@ class CausalLM:
                 self._insert_prefill[pkey] = (
                     jax.jit(prefill_fn).lower(self.params, ids0).compile())
         if rows not in self._insert_scatter:
+            # pin the scatter OUTPUT to replicated: under a TP mesh the
+            # freshly prefilled rows arrive head-sharded, and a plain jit
+            # would propagate that sharding onto the session cache — which
+            # the AOT-compiled session programs (lowered on replicated cache
+            # avals) then reject at their next call. The constraint reshards
+            # only the inserted rows (O(rows)), keeping the insert contract.
+            constrain = self._replicate_out
             self._insert_scatter[rows] = jax.jit(
-                lambda old, fresh, slots, new_len: _scatter_cache_rows(
-                    old, fresh, slots, new_len, rows),
+                lambda old, fresh, slots, new_len: constrain(
+                    _scatter_cache_rows(old, fresh, slots, new_len, rows)),
                 donate_argnums=(0,),
             )
         return self._insert_prefill[pkey], self._insert_scatter[rows]
 
+    def _replicate_out(self, tree: PyTree) -> PyTree:
+        """Inside-jit constraint forcing every leaf fully replicated when a
+        device mesh is active (no-op otherwise) — session-cache-producing
+        programs must hand back the replicated layout the AOT session
+        programs were lowered with."""
+        from neuronx_distributed_tpu.parallel import mesh as ps
+
+        if not ps.model_parallel_is_initialized():
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, repl), tree)
+
+    def _paged_insert_programs(self, rows: int, bucket: int):
+        """Lazily compile the paged insert for ``rows`` prompts at suffix
+        width ``bucket``: ONE donated program that (a) prefills the suffix
+        tokens at their own batch width, reading shared prefix pages through
+        the rows' block tables (prefix-hit TTFT = suffix prefill only), (b)
+        writes the fresh K/V straight into the session's page pool (no
+        separate scatter pass — the pool is global, so the prefill IS the
+        scatter), and (c) updates the session-width cache_index/block_table
+        rows at ``slots``."""
+        key = (rows, bucket)
+        if key in self._paged_insert:
+            return self._paged_insert[key]
+        ppseq = self.config.max_seq_len // self.config.page_size
+
+        def insert_fn(params, cache, ids, tables, slots, starts, new_len):
+            def as_rows(path, leaf):
+                p = jax.tree_util.keystr(path)
+                if p.endswith("['cache_index']"):
+                    return jnp.broadcast_to(
+                        starts.astype(leaf.dtype), (leaf.shape[0], rows))
+                if p.endswith("['block_table']"):
+                    return jnp.broadcast_to(
+                        tables[None], (leaf.shape[0], rows, ppseq))
+                return leaf  # the pool itself is batch-independent
+
+            row_cache = jax.tree_util.tree_map_with_path(as_rows, cache)
+            logits, mut = self.model.apply(
+                {"params": self._resolve(params), "cache": row_cache}, ids,
+                mutable=["cache"])
+
+            def back(path, old, new):
+                p = jax.tree_util.keystr(path)
+                if p.endswith("['cache_index']"):
+                    out = old
+                    for i in range(rows):
+                        v = jnp.broadcast_to(new_len[i].astype(old.dtype),
+                                             (old.shape[0], 1))
+                        out = jax.lax.dynamic_update_slice_in_dim(
+                            out, v, slots[i], axis=1)
+                    return out
+                if p.endswith("['block_table']"):
+                    out = old
+                    for i in range(rows):
+                        v = jnp.broadcast_to(
+                            tables[i].astype(old.dtype)[None, None],
+                            (old.shape[0], 1, ppseq))
+                        out = jax.lax.dynamic_update_slice_in_dim(
+                            out, v, slots[i], axis=1)
+                    return out
+                return new  # mutated pool leaves
+
+            return logits, self._replicate_out(
+                jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
+
+        self._paged_insert[key] = (
+            jax.jit(insert_fn, donate_argnums=(1,))
+            .lower(self.params, self._cache_avals(),
+                   jnp.zeros((rows, bucket), jnp.int32),
+                   jnp.zeros((rows, ppseq), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32))
+            .compile())
+        return self._paged_insert[key]
+
+    def _insert_paged(self, session: "DecodeSession", slot_ids: np.ndarray,
+                      prompt_ids: np.ndarray, lengths: np.ndarray,
+                      reserve_tokens) -> jax.Array:
+        """Paged admission: per-row prefix lookup + page allocation (host),
+        then ONE suffix-width prefill-and-scatter program. ``reserve_tokens``
+        (scalar or per-row) bounds the decode room reserved in pages —
+        writes past it land in the slot's scratch page, never a neighbour.
+        Raises :class:`PagePoolExhausted` BEFORE any device work when the
+        pool (after LRU eviction of cache-only prefix pages) cannot cover
+        the whole group — the scheduler defers and retries."""
+        pkv = session.paged
+        rows = len(slot_ids)
+        if reserve_tokens is None:
+            totals = np.full((rows,), self.config.max_seq_len, np.int64)
+        else:
+            totals = lengths.astype(np.int64) + np.broadcast_to(
+                np.asarray(reserve_tokens, np.int64), (rows,))
+        plans = []
+        try:
+            for i in range(rows):
+                plans.append(pkv.plan(
+                    prompt_ids[i, : lengths[i]].tolist(), int(totals[i])))
+        except Exception:
+            for p in plans:
+                pkv.rollback(p)
+            raise
+        starts = np.asarray([p.start for p in plans], np.int32)
+        suffix = lengths - starts                      # >= 1 by plan()'s clamp
+        bucket = self._bucket_for(int(suffix.max()))
+        ids = np.zeros((rows, bucket), np.int32)
+        for i in range(rows):
+            ids[i, : suffix[i]] = prompt_ids[i, starts[i]: lengths[i]]
+        tables = np.stack([pkv.table_for(int(slot_ids[i]), plans[i])
+                           for i in range(rows)])
+        prog = self._paged_insert_programs(rows, bucket)
+        logits, cache = prog(
+            self.params, session.cache, jnp.asarray(ids), jnp.asarray(tables),
+            jnp.asarray(slot_ids), jnp.asarray(starts),
+            jnp.asarray(lengths, np.int32))
+        session.cache = cache
+        for i in range(rows):
+            pkv.commit(int(slot_ids[i]), plans[i],
+                       prompt_ids[i, : lengths[i]].tolist())
+        session.lengths[slot_ids] = lengths
+        session.active[slot_ids] = True
+        last = jnp.asarray(np.maximum(suffix - 1, 0))
+        return logits[jnp.arange(rows), last]
+
     def insert(self, session: "DecodeSession", slot_ids: np.ndarray,
                prompt_ids: np.ndarray, lengths: Optional[np.ndarray] = None,
-               pad_token_id: int = 0) -> jax.Array:
+               pad_token_id: int = 0,
+               reserve_tokens: Optional[Any] = None) -> jax.Array:
         """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
         rows and lengths are preserved (they may be mid-generation).
 
@@ -442,6 +673,12 @@ class CausalLM:
                 f"prompt length {int(lengths.max())} leaves no decode room in "
                 f"max_seq_len {self.config.max_seq_len}"
             )
+        if self.paged:
+            if session.paged is None:
+                raise ValueError("paged CausalLM needs a session from "
+                                 "start_session() (no paged state attached)")
+            return self._insert_paged(session, slot_ids, prompt_ids, lengths,
+                                      reserve_tokens)
         bucket = self._bucket_for(s)
         rows = len(slot_ids)
         prefill, scatter = self._insert_programs(rows, bucket)
@@ -487,6 +724,15 @@ class CausalLM:
                 f"slot ids {slot_ids.tolist()} out of range [0, {self.max_batch})"
             )
         session.active[slot_ids] = False
+        if self.paged and session.paged is not None:
+            # return pages to the free list (prefix-cached pages stay
+            # resident for future hits) and point the retired slots' DEVICE
+            # tables back at scratch, so a retired slot's residual decode
+            # writes can never bleed into pages a later request reuses
+            for slot in slot_ids:
+                session.paged.release(int(slot))
+            session.cache = _set_block_tables(session.cache,
+                                              session.paged.tables)
 
     # --- generation ------------------------------------------------------
 
@@ -515,6 +761,10 @@ class CausalLM:
         ``pad_token_id``) — output is token-identical to the stepwise path;
         the device may still compute (never return) up to K-1 tokens past
         the point where every row finished."""
+        if self.paged:
+            raise ValueError(
+                "generate() runs the contiguous-slot path; a paged CausalLM "
+                "serves through sessions (insert/step) or ServeEngine")
         if self._decode is None:
             self.compile()
         sampler = sampler or Sampler(greedy=True)

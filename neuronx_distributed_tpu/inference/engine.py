@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM
+from neuronx_distributed_tpu.inference.paged_cache import PagePoolExhausted
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
 
@@ -125,9 +126,13 @@ class ServeEngine:
         self._tok = np.zeros((b,), np.int32)
         self._next_id = 0
         self.blocks = 0
+        # paged mode (lm built with page_size): admission additionally
+        # consults the prefix index + page allocator — a prefix hit prefills
+        # only the suffix, pool pressure defers admission instead of OOMing
+        self.paged = bool(getattr(lm, "paged", False))
         self.stats = {"blocks": 0, "decode_blocks": 0, "inserts": 0,
                       "inserted_requests": 0, "program_calls": 0,
-                      "host_fetches": 0}
+                      "host_fetches": 0, "deferred_admissions": 0}
 
     # --- submission ------------------------------------------------------
 
@@ -153,6 +158,16 @@ class ServeEngine:
             raise ValueError(
                 f"prompt length {prompt.size} exceeds largest bucket "
                 f"{self.lm.buckets[-1]}")
+        if self.paged:
+            pkv = self.session.paged
+            need = pkv.pages_needed(prompt.size,
+                                    max_new_tokens + self.block_steps)
+            if need > pkv.capacity_pages():
+                # reject now: a request no drained pool could ever hold
+                # would otherwise deadlock the admission queue
+                raise ValueError(
+                    f"request needs {need} pages, pool holds at most "
+                    f"{pkv.capacity_pages()}")
         sampler = sampler or Sampler(greedy=True)
         if (sampler.top_k, sampler.top_p) != (self.slot_sampler.top_k,
                                               self.slot_sampler.top_p):
@@ -196,7 +211,24 @@ class ServeEngine:
                    and self.queue[0].arrival_block <= self.blocks
                    and self.lm._bucket_for(self.queue[0].prompt.size) == bucket):
                 group.append(self.queue.popleft())
-            self._insert_group(group, free[: len(group)], bucket)
+            try:
+                self._insert_group(group, free[: len(group)], bucket)
+            except PagePoolExhausted:
+                # pool pressure (paged mode): the group insert is atomic and
+                # no device work ran (allocation precedes the program).
+                # Requeue and retry at the next block boundary — in-flight
+                # retirements return pages. Fall back to admitting the head
+                # alone first: with nothing in flight a too-big group would
+                # otherwise never shrink (submit() guarantees any single
+                # request fits a drained pool, so the head always progresses
+                # eventually).
+                self.stats["deferred_admissions"] += 1
+                self.queue.extendleft(reversed(group[1:]))
+                try:
+                    self._insert_group(group[:1], free[:1], bucket)
+                except PagePoolExhausted:
+                    self.queue.appendleft(group[0])
+                    return
 
     def _insert_group(self, group: List[Request], slot_ids: List[int],
                       bucket: int) -> None:
@@ -206,9 +238,15 @@ class ServeEngine:
         for i, r in enumerate(group):
             ids[i, : r.prompt.size] = r.prompt
             lens[i] = r.prompt.size
+        # paged mode reserves pages for the decode room only (budget + one
+        # block of post-budget overrun writes, which land in owned pages or
+        # scratch — never a neighbour); the contiguous path ignores the kwarg
+        reserve = np.asarray(
+            [r.max_new_tokens + self.block_steps for r in group], np.int64)
         logits = self.lm.insert(self.session, np.asarray(slot_ids, np.int32),
                                 ids, lengths=lens,
-                                pad_token_id=self.pad_token_id)
+                                pad_token_id=self.pad_token_id,
+                                reserve_tokens=reserve if self.paged else None)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
         # first token per inserted request: sampled from the prefill logits
@@ -357,19 +395,25 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     prompt_lens=(8, 16), max_new_tokens: int = 16,
                     mean_interarrival_blocks: float = 0.5,
                     eos_token_id: Optional[int] = None,
+                    shared_prefix_len: int = 0,
                     seed: int = 0) -> List[dict]:
     """Deterministic synthetic arrival trace (virtual time in blocks):
     exponential inter-arrivals, prompt lengths cycled through
     ``prompt_lens`` — the multi-tenant workload shape the serving bench and
-    the ``runner.py serve`` entrypoint replay."""
+    the ``runner.py serve`` entrypoint replay. ``shared_prefix_len > 0``
+    prepends ONE common random prefix of that many tokens to every prompt
+    (the system-prompt / few-shot-header workload shape the paged engine's
+    prefix cache exists for; prompt_lens then size the per-request tail)."""
     rs = np.random.RandomState(seed)
+    prefix = rs.randint(1, vocab_size, (shared_prefix_len,)).astype(np.int32)
     t = 0.0
     trace = []
     for i in range(num_requests):
         t += rs.exponential(mean_interarrival_blocks)
         s = int(prompt_lens[i % len(prompt_lens)])
+        tail = rs.randint(1, vocab_size, (s,)).astype(np.int32)
         trace.append({
-            "prompt": rs.randint(1, vocab_size, (s,)).astype(np.int32),
+            "prompt": np.concatenate([prefix, tail]) if shared_prefix_len else tail,
             "max_new_tokens": max_new_tokens,
             "eos_token_id": eos_token_id,
             "arrival_block": int(t),
@@ -416,4 +460,21 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         "decode_blocks_mean": round(float(np.mean(
             [c.decode_blocks for c in completions])), 2) if completions else None,
     }
+    pkv = getattr(engine.session, "paged", None)
+    if pkv is not None:
+        kv = engine.lm.kv_cache_bytes()
+        report.update({
+            "paged": True,
+            "page_size": pkv.page_size,
+            "page_pool_pages": pkv.num_pages,
+            "prefix_queries": pkv.stats["prefix_queries"],
+            "prefix_hits": pkv.stats["prefix_hits"],
+            "prefix_hit_tokens": pkv.stats["prefix_hit_tokens"],
+            "pages_in_use_peak": pkv.stats["pages_in_use_peak"],
+            "evicted_pages": pkv.stats["evicted_pages"],
+            "deferred_admissions": engine.stats["deferred_admissions"],
+            "kv_hbm_bytes": kv["kv_bytes"],
+            "kv_slab_hbm_bytes": kv["kv_slab_bytes"],
+            "kv_hbm_vs_slab": round(kv["kv_bytes"] / kv["kv_slab_bytes"], 3),
+        })
     return report

@@ -1,0 +1,314 @@
+"""Paged KV cache: host-side page allocator + radix prefix index for the
+serving engine (PagedAttention, Kwon et al. 2023; RadixAttention, Zheng et
+al. 2024 — PAPERS.md serving rows).
+
+Device layout (models/llama.py, decode-attention paged branch): each layer
+holds a K and a V page POOL of ``page_pool_pages`` pages x ``page_size``
+tokens instead of a ``max_batch x max_seq_len`` slab; a per-slot block table
+``(max_batch, max_seq_len/page_size)`` of physical page ids rides the flax
+``cache`` collection, so every compiled serving program — right-sized
+insert, step decode, the fused K-step session scan — keeps its signature
+and its one-dispatch-per-K-tokens contract. Attention resolves logical slot
+positions through an in-scan gather of the pool; stale bytes in reused
+pages sit behind the position mask exactly like the slab's unwritten zeros,
+which is what makes paged attention bit-identical to the contiguous oracle.
+
+Host layout (this module):
+
+* :class:`PageAllocator` — free-list + per-page refcounts. A page is
+  returned to the free list when its last holder (active slot or prefix
+  cache) releases it.
+* :class:`RadixPrefixIndex` — a trie over PROMPT pages: each node is one
+  page whose ``page_size`` tokens AND full prefix match the path from the
+  root, holding the physical page whose K/V encode exactly that prefix.
+  Lookup returns the longest page-aligned cached prefix; admission then
+  skips prefill of the shared pages entirely (insert cost O(suffix)).
+  Cache-only pages are evicted LRU-leaf-first under pool pressure.
+* :class:`PagedKVCache` — per-session bookkeeping: block tables, per-slot
+  scratch pages, the plan/commit/rollback/release lifecycle that
+  ``CausalLM.insert``/``retire`` drive.
+
+Sharing is copy-on-write by construction rather than by copying: shared
+pages cover only FULL pages strictly below a request's private region (the
+last prompt token always stays in the suffix, so the divergence page is
+recomputed privately), and every write — suffix prefill, decode, padding
+garbage — lands in privately owned or scratch pages. A shared page is
+therefore immutable until its refcount drains to zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PagePoolExhausted(RuntimeError):
+    """Not enough free pages for an admission, even after evicting
+    cache-only prefix pages. The scheduler defers the request (pages free up
+    as in-flight requests retire)."""
+
+
+class PageAllocator:
+    """Free-list page allocator with per-page refcounts. ``reserved`` pages
+    at the front of the id space never enter the free list (the per-slot
+    scratch pages overrun writes land in)."""
+
+    def __init__(self, num_pages: int, reserved: int = 0):
+        if num_pages <= reserved:
+            raise ValueError(f"pool of {num_pages} pages <= {reserved} reserved")
+        self.num_pages = int(num_pages)
+        self.reserved = int(reserved)
+        self._free = deque(range(reserved, num_pages))
+        self.refcount = np.zeros((num_pages,), np.int32)
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> int:
+        return self.num_pages - self.reserved - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None when the pool can't cover."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self.refcount[p] = 1
+        return pages
+
+    def retain(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(f"retain of free page {p}")
+            self.refcount[p] += 1
+
+    def release(self, pages: Sequence[int]) -> List[int]:
+        """Drop one hold per page; returns the pages that hit refcount 0 and
+        went back to the free list."""
+        freed = []
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(f"release of free page {p}")
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+
+class _Node:
+    __slots__ = ("children", "page", "parent", "key", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.children: Dict[tuple, _Node] = {}
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.last_used = 0
+
+
+class RadixPrefixIndex:
+    """Page-granular prompt prefix trie. Each cached page holds one
+    allocator refcount; eviction (LRU over leaves) drops that hold so pages
+    unreferenced by any active slot return to the free list."""
+
+    def __init__(self, page_size: int, allocator: PageAllocator):
+        self.page_size = int(page_size)
+        self.allocator = allocator
+        self.root = _Node(None, -1, None)
+        self._clock = 0
+        self.cached_pages = 0
+
+    def lookup(self, tokens: Sequence[int]) -> List[int]:
+        """Physical page ids of the longest cached page-aligned prefix of
+        ``tokens`` (possibly empty), LRU-touched along the path."""
+        ps = self.page_size
+        self._clock += 1
+        node, pages = self.root, []
+        for i in range(len(tokens) // ps):
+            child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            child.last_used = self._clock
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def register(self, tokens: Sequence[int], pages: Sequence[int]) -> None:
+        """Record prompt pages AFTER their K/V were written. A page whose
+        path already exists keeps the existing entry (the new physical copy
+        stays request-private and is freed at retire); new entries take one
+        cache refcount hold."""
+        ps = self.page_size
+        if len(pages) * ps > len(tokens):
+            raise ValueError("register: pages exceed token coverage")
+        self._clock += 1
+        node = self.root
+        for i, page in enumerate(pages):
+            key = tuple(tokens[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, int(page), node)
+                node.children[key] = child
+                self.allocator.retain([int(page)])
+                self.cached_pages += 1
+            child.last_used = self._clock
+            node = child
+
+    def evict(self, n_pages: int) -> int:
+        """Evict LRU leaf pages whose only hold is the cache's, until
+        ``n_pages`` pages returned to the free list (or no candidate is
+        left). Returns the number actually freed."""
+        freed = 0
+        while freed < n_pages:
+            leaves = [c for c in self._iter_nodes()
+                      if not c.children and self.allocator.refcount[c.page] == 1]
+            if not leaves:
+                return freed
+            victim = min(leaves, key=lambda c: c.last_used)
+            del victim.parent.children[victim.key]
+            self.cached_pages -= 1
+            freed += len(self.allocator.release([victim.page]))
+        return freed
+
+    def _iter_nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+
+@dataclasses.dataclass
+class InsertPlan:
+    """One admission's page layout: ``table`` is the full block-table row
+    (shared pages, then owned pages, scratch fill), ``start`` the page-
+    aligned length of the reused prefix (suffix prefill begins there)."""
+
+    table: np.ndarray
+    start: int
+    prompt_len: int
+    shared: List[int]
+    owned: List[int]
+
+
+class PagedKVCache:
+    """Per-session host state for the paged pool: block tables, scratch
+    pages, allocator, prefix index, and the insert/retire lifecycle."""
+
+    def __init__(self, page_size: int, num_pages: int, max_batch: int,
+                 max_seq_len: int, prefix_cache: bool = True):
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq_len {max_seq_len}")
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.pages_per_slot = max_seq_len // page_size
+        if num_pages < max_batch + 1:
+            # scratch pages + at least one allocatable page; per-request
+            # feasibility against the pool is the scheduler's job (the
+            # engine validates pages_needed() <= capacity_pages() at submit)
+            raise ValueError(
+                f"pool of {num_pages} pages cannot hold {max_batch} scratch "
+                f"pages + one allocatable page")
+        # page i < max_batch is slot i's scratch page: the target of unowned
+        # table entries, so overrun/garbage writes never touch live pages
+        self.scratch = np.arange(max_batch, dtype=np.int32)
+        self.allocator = PageAllocator(num_pages, reserved=max_batch)
+        self.prefix: Optional[RadixPrefixIndex] = (
+            RadixPrefixIndex(page_size, self.allocator) if prefix_cache else None)
+        self.tables = np.tile(self.scratch[:, None],
+                              (1, self.pages_per_slot)).astype(np.int32)
+        self._slot_pages: Dict[int, List[int]] = {}
+        self.stats = {"prefix_queries": 0, "prefix_hits": 0,
+                      "prefix_hit_tokens": 0, "evicted_pages": 0,
+                      "pages_in_use_peak": 0}
+
+    # --- admission lifecycle --------------------------------------------
+
+    def plan(self, tokens: Sequence[int], reserve_total: int) -> InsertPlan:
+        """Plan one admission: longest page-aligned cached prefix (clamped
+        below the last prompt token, so suffix prefill is never empty) plus
+        freshly allocated pages covering ``reserve_total`` logical tokens.
+        Tries LRU eviction of cache-only pages before raising
+        :class:`PagePoolExhausted`. Holds are taken here — pair every plan
+        with :meth:`commit` or :meth:`rollback`."""
+        ps = self.page_size
+        plen = len(tokens)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        shared: List[int] = []
+        if self.prefix is not None:
+            self.stats["prefix_queries"] += 1
+            shared = self.prefix.lookup(tokens)[: (plen - 1) // ps]
+            if shared:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += len(shared) * ps
+        start = len(shared) * ps
+        total = min(max(int(reserve_total), plen), self.max_seq_len)
+        n_owned = -(-total // ps) - len(shared)
+        # hold the shared pages FIRST: at refcount 1 (cache-only) the LRU
+        # eviction below could otherwise free the very pages this plan reuses
+        self.allocator.retain(shared)
+        owned = self.allocator.alloc(n_owned)
+        if owned is None:
+            if self.prefix is not None:
+                self.stats["evicted_pages"] += self.prefix.evict(
+                    n_owned - self.allocator.available())
+            owned = self.allocator.alloc(n_owned)
+            if owned is None:
+                self.allocator.release(shared)
+                raise PagePoolExhausted(
+                    f"need {n_owned} pages, {self.allocator.available()} free")
+        table = np.empty((self.pages_per_slot,), np.int32)
+        table[: len(shared)] = shared
+        table[len(shared): len(shared) + n_owned] = owned
+        table[len(shared) + n_owned:] = -1   # scratch fill, set at commit
+        return InsertPlan(table=table, start=start, prompt_len=plen,
+                          shared=list(shared), owned=list(owned))
+
+    def rollback(self, plan: InsertPlan) -> None:
+        self.allocator.release(plan.shared)
+        self.allocator.release(plan.owned)
+
+    def table_for(self, slot: int, plan: InsertPlan) -> np.ndarray:
+        t = plan.table.copy()
+        t[t < 0] = self.scratch[slot]
+        return t
+
+    def commit(self, slot: int, plan: InsertPlan, tokens: Sequence[int]) -> None:
+        """Install the plan on ``slot`` (releasing whatever it held) and
+        register the prompt's fully-covered pages in the prefix index."""
+        self.release(slot)
+        self.tables[slot] = self.table_for(slot, plan)
+        self._slot_pages[slot] = plan.shared + plan.owned
+        if self.prefix is not None:
+            n_full = plan.prompt_len // self.page_size
+            self.prefix.register(list(tokens)[: n_full * self.page_size],
+                                 [int(p) for p in self.tables[slot, :n_full]])
+        self.stats["pages_in_use_peak"] = max(
+            self.stats["pages_in_use_peak"], self.allocator.in_use())
+
+    def release(self, slot: int) -> None:
+        """Drop the slot's page holds (pages cached in the prefix index stay
+        resident until evicted) and point its table back at scratch — a
+        retired slot's residual device writes can then never land in a page
+        a later request owns (the scatter-isolation analogue)."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.allocator.release(pages)
+        self.tables[slot] = self.scratch[slot]
+
+    # --- sizing ----------------------------------------------------------
+
+    def pages_needed(self, prompt_len: int, new_tokens: int) -> int:
+        total = min(prompt_len + new_tokens, self.max_seq_len)
+        return -(-total // self.page_size)
+
+    def capacity_pages(self) -> int:
+        return self.num_pages - self.max_batch

@@ -92,8 +92,13 @@ def _make_proposer(draft: CausalLM, num_draft: int, greedy: bool, temperature: f
     steps) — kills the per-token host round-trip of v1."""
 
     def proposer(params, cache, last_tok, rng):
-        return _propose(draft, num_draft, greedy, temperature,
-                        params, cache, last_tok, rng)
+        toks, probs, cache = _propose(draft, num_draft, greedy, temperature,
+                                      params, cache, last_tok, rng)
+        # cache outputs pin replicated at every program boundary (see
+        # CausalLM._replicate_out): the cache round-trips between separately
+        # compiled programs whose inputs are replicated — an unconstrained
+        # output lets GSPMD hand back a sharded cache the next call rejects
+        return toks, probs, draft._replicate_out(cache)
 
     return jax.jit(proposer, donate_argnums=(1,))
 
@@ -220,7 +225,11 @@ def _build_round_block(target: CausalLM, draft: CausalLM, num_draft: int,
         carry, (toks, keeps, accs) = jax.lax.scan(
             round_body, carry, None, length=rounds)
         t_cache, d_cache, last_tok, cur_len, emitted, done, rng = carry
-        return (t_cache, d_cache, last_tok, cur_len, emitted, done, rng,
+        # program-boundary pin (CausalLM._replicate_out): both caches feed
+        # this same compiled block again next call — outputs must hand back
+        # the replicated layout the block was lowered with
+        return (target._replicate_out(t_cache), draft._replicate_out(d_cache),
+                last_tok, cur_len, emitted, done, rng,
                 toks, keeps, accs)
 
     return block_fn
@@ -416,7 +425,9 @@ def speculative_generate(
             {"params": target._resolve(params), "cache": cache}, ids,
             mutable=["cache"]
         )
-        return logits, mut["cache"]
+        # program-boundary pin (CausalLM._replicate_out): the cache feeds
+        # this same AOT program again next round
+        return logits, target._replicate_out(mut["cache"])
 
     b = target.max_batch
     s = prompt_ids.shape[1]

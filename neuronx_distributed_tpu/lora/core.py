@@ -119,6 +119,32 @@ def merge_lora(params: PyTree, lora_params: PyTree, config: LoraConfig) -> PyTre
     return jax.tree_util.tree_map_with_path(merge_leaf, params)
 
 
+def export_merged_hf(params: PyTree, lora_params: PyTree, config: LoraConfig,
+                     model_config, out_dir: str, family: str = "llama",
+                     dtype=None) -> str:
+    """Adapter-only LoRA serving export (ROADMAP #8): merge ``W + s*A@B``
+    and write a standard HF checkpoint through ``converters/hf.py``, so any
+    HF-compatible serving stack — including this repo's ``--hf_checkpoint``
+    path — reloads the tuned model with NO LoRA machinery at serve time.
+    Round-trip exactness (merged forward == reloaded forward, bit-identical
+    at fp32) is the tested contract. Returns the safetensors path."""
+    import os
+
+    import numpy as np
+
+    from neuronx_distributed_tpu.converters.hf import FAMILIES
+    from neuronx_distributed_tpu.converters.hf_llama import save_hf_safetensors
+
+    merged = merge_lora(params, lora_params, config)
+    fam = FAMILIES[family]
+    state = fam.nxd_to_hf(jax.tree.map(np.asarray, merged), model_config,
+                          dtype=dtype or np.float32)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "model.safetensors")
+    save_hf_safetensors(state, path)
+    return path
+
+
 def attach_adapters(params: PyTree, lora_params: PyTree, config: LoraConfig,
                     rng: jax.Array) -> PyTree:
     """Params tree for the EXACT dropout forward: each targeted linear kernel

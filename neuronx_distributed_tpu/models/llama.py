@@ -94,6 +94,15 @@ class LlamaConfig:
     # CE loss sequence-chunking (long-seq memory lever): the head matmul +
     # CE run per chunk of this many tokens when seq exceeds it (None = 4096)
     loss_chunk_size: Optional[int] = None
+    # paged KV cache (serving, decode=True only): per-layer page pool of
+    # ``page_pool_pages`` pages x ``page_size`` tokens; slot positions
+    # resolve through per-slot block tables that RIDE THE CACHE COLLECTION,
+    # so compiled programs keep their signatures (inference/paged_cache.py).
+    # None = the contiguous max_batch x max_seq_len slab. page_size must
+    # divide max_seq_len so the gathered logical view keeps the slab's shape
+    # (that shape equality is what makes paged attention bit-identical).
+    page_size: Optional[int] = None
+    page_pool_pages: Optional[int] = None
 
     @property
     def head_dim_(self) -> int:
@@ -338,10 +347,26 @@ class LlamaAttention(nn.Module):
         s_new = x.shape[1]
         n_kv = k.shape[2]
         hd = cfg.head_dim_
-        ck = self.variable("cache", "cached_key",
-                           jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
-        cv = self.variable("cache", "cached_value",
-                           jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
+        ps = cfg.page_size
+        if ps:
+            # paged KV (PagedAttention layout, TPU-shaped): the layer owns a
+            # page POOL instead of a per-slot slab; per-slot block tables are
+            # a cache-collection leaf, so the host swaps them between blocks
+            # without touching any program signature and the K-step session
+            # scan carries them as loop-invariant state (in-scan gather).
+            npages = cfg.page_pool_pages
+            ppseq = cfg.max_seq_len // ps
+            ck = self.variable("cache", "cached_key",
+                               jnp.zeros, (npages, ps, n_kv, hd), cfg.dtype)
+            cv = self.variable("cache", "cached_value",
+                               jnp.zeros, (npages, ps, n_kv, hd), cfg.dtype)
+            bt = self.variable("cache", "block_table",
+                               lambda: jnp.zeros((b, ppseq), jnp.int32))
+        else:
+            ck = self.variable("cache", "cached_key",
+                               jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
+            cv = self.variable("cache", "cached_value",
+                               jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
         # per-slot lengths: continuous batching reorders/restarts slots
         # independently (reference model_wrapper.py:207 seq_ids machinery)
         ci = self.variable("cache", "cache_index",
@@ -365,8 +390,34 @@ class LlamaAttention(nn.Module):
                                     scaling=cfg.rope_scaling)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        ck.value = ck.value.at[rows, slots].set(k.astype(ck.value.dtype))
-        cv.value = cv.value.at[rows, slots].set(v.astype(cv.value.dtype))
+        if ps:
+            # write through the block table: logical slot -> physical page.
+            # Writes at slots >= max_seq_len are DROPPED, matching the slab
+            # path's out-of-bounds scatter (the overflow latch freezes a row
+            # instead of letting its writes wrap onto a neighbour).
+            table = bt.value                                       # (b, ppseq)
+            page_of = jnp.clip(slots // ps, 0, ppseq - 1)
+            phys = jnp.take_along_axis(table, page_of, axis=1)     # (b, s_new)
+            flat = jnp.where(slots < cfg.max_seq_len,
+                             phys * ps + slots % ps, npages * ps)
+            kf = ck.value.reshape(npages * ps, n_kv, hd)
+            vf = cv.value.reshape(npages * ps, n_kv, hd)
+            kf = kf.at[flat].set(k.astype(kf.dtype), mode="drop")
+            vf = vf.at[flat].set(v.astype(vf.dtype), mode="drop")
+            ck.value = kf.reshape(npages, ps, n_kv, hd)
+            cv.value = vf.reshape(npages, ps, n_kv, hd)
+            # in-scan gather: the (b, max_seq_len) logical view the attention
+            # below consumes. Stale bytes in reused pages sit behind the
+            # position mask exactly like the slab's unwritten zeros (masked
+            # scores are -1e30 -> exactly-zero probs), so attention over the
+            # view is bit-identical to the contiguous path.
+            lpos = jnp.arange(cfg.max_seq_len)
+            all_flat = table[:, lpos // ps] * ps + (lpos % ps)[None, :]
+            k_all, v_all = kf[all_flat], vf[all_flat]
+        else:
+            ck.value = ck.value.at[rows, slots].set(k.astype(ck.value.dtype))
+            cv.value = cv.value.at[rows, slots].set(v.astype(cv.value.dtype))
+            k_all, v_all = ck.value, cv.value
         ci.value = idx + s_new
         if chunk_mask is not None:
             # prefix slots (< idx) fully visible; chunk slots by tree mask
@@ -379,7 +430,7 @@ class LlamaAttention(nn.Module):
             cm = jnp.broadcast_to(chunk_mask.astype(bool)[None], (b, s_new, s_new))
             tree = jnp.take_along_axis(cm, rel_c.astype(jnp.int32), axis=2)
             mask = prefix | (in_chunk & tree)
-            o = cached_attention(q, ck.value, cv.value, idx, mask=mask)
+            o = cached_attention(q, k_all, v_all, idx, mask=mask)
             o = o.reshape(b, s_new, -1)
             return self._o_proj(o)
         # prefill/chunk attention: the Pallas kernel with per-slot position
@@ -400,8 +451,8 @@ class LlamaAttention(nn.Module):
         if use_flash:
             o = attention(
                 q.transpose(0, 2, 1, 3),
-                ck.value.transpose(0, 2, 1, 3),
-                cv.value.transpose(0, 2, 1, 3),
+                k_all.transpose(0, 2, 1, 3),
+                v_all.transpose(0, 2, 1, 3),
                 causal=False,
                 use_flash=True,
                 block_q=blk_q,
@@ -411,7 +462,7 @@ class LlamaAttention(nn.Module):
             )
             o = o.transpose(0, 2, 1, 3)
         else:
-            o = cached_attention(q, ck.value, cv.value, idx)
+            o = cached_attention(q, k_all, v_all, idx)
         o = o.reshape(b, s_new, -1)
         return self._o_proj(o)
 

@@ -47,7 +47,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_insert_ms_1slot": 21.0,
                                       "serve_insert_fullwidth_ms_1slot": 60.0,
                                       "serve_fused_round_device_ms": 130.0,
-                                      "serve_fused_vs_generate_fused16": 1.05})
+                                      "serve_fused_vs_generate_fused16": 1.05,
+                                      "serve_cold_ttft_ms": 95.0,
+                                      "serve_prefix_hit_ttft_ms": 24.0,
+                                      "serve_prefix_hit_ttft_ratio": 0.253,
+                                      "paged_hbm_bytes_vs_slab": 0.542,
+                                      "serve_tokens_per_sec_paged": 498.0})
     import neuronx_distributed_tpu.utils.cp_microbench as cpm
     monkeypatch.setattr(cpm, "measure_cp_ratio_isolated", lambda *a, **kw: {
         "cp_vs_sp_throughput": 0.97, "cp_vs_sp_throughput_ici_serial": 0.95,
@@ -86,6 +91,19 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_insert_ms_1slot"] == 21.0
     assert h["serve_insert_ms_1slot"] < h["serve_insert_fullwidth_ms_1slot"]
     assert h["serve_fused_round_device_ms"] == 130.0
+    # paged serving keys (ISSUE 3): prefix-hit TTFT must undercut cold TTFT
+    # on both surfaces, and the HBM ratio rides the headline
+    assert d["serve_prefix_hit_ttft_ms"] == h["serve_prefix_hit_ttft_ms"] == 24.0
+    assert h["serve_prefix_hit_ttft_ms"] < h["serve_cold_ttft_ms"]
+    assert h["serve_prefix_hit_ttft_ratio"] == 0.253
+    assert h["paged_hbm_bytes_vs_slab"] == 0.542
+    assert h["serve_tokens_per_sec_paged"] == 498.0
+    # machine-state record (ISSUE 3 satellite): jax/jaxlib versions + XLA
+    # flags land in the SIDECAR for cross-run comparability checks — and
+    # stay out of the size-capped headline
+    assert d["env"]["jax_version"] and "backend" in d["env"]
+    assert "xla_flags" in d["env"] and "jaxlib_version" in d["env"]
+    assert "env" not in h
     assert h["full_report"] == "BENCH_REPORT.json"
     assert "unit" not in h and "train_step_time_s_measured" not in h
     assert len(json.dumps(h)) < 1900, "headline must survive a 2000-byte tail"

@@ -157,6 +157,46 @@ def test_inference_runner_serve_tiny(capsys):
     assert report["tokens_per_sec"] > 0
 
 
+def test_inference_runner_serve_paged_tiny(capsys):
+    """ISSUE 3 CI gate: runner.py serve --paged drives the paged KV engine
+    (page_size 4 forces multi-page prompts at tiny scale) over a shared-
+    prefix trace — requests complete, the dispatch contract holds, and the
+    paged report surface (hit accounting, pool-vs-slab bytes) is present."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--paged", "--page_size", "4",
+                 "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3",
+                 "--shared_prefix_len", "8", "--mean_interarrival", "3.0"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["host_ops_per_block"] == 2.0
+    assert report["paged"] is True and report["page_size"] == 4
+    assert report["prefix_queries"] == 4
+    assert report["prefix_hit_tokens"] >= 8     # later arrivals reuse the prefix
+    assert report["kv_hbm_bytes"] > 0 and report["kv_hbm_vs_slab"] > 0
+
+
+@pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
+# fast smokes above
+def test_inference_runner_serve_paged_matches_contiguous(capsys):
+    """--paged replays the same trace the contiguous engine serves: same
+    completions, same token counts (the bit-identity oracle at the CLI
+    surface; the token-level assertion lives in test_paged_cache.py)."""
+    import runner
+
+    args = ["serve", "--tiny", "--max_batch", "2", "--num_requests", "5",
+            "--max_new_tokens", "8", "--fused_steps", "4"]
+    runner.main(args)
+    contig = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    runner.main(args + ["--paged", "--page_size", "4"])
+    paged = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert contig["requests_completed"] == paged["requests_completed"] == 5
+    assert contig["total_generated_tokens"] == paged["total_generated_tokens"]
+    assert paged["host_ops_per_block"] == contig["host_ops_per_block"] == 2.0
+
+
 @pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
 # fast smoke above
 def test_inference_runner_serve_stepwise_matches_fused(capsys):

@@ -216,6 +216,23 @@ def test_session_fused_overflow_guard_freezes_not_wraps(lm):
     assert (toks[:, 2] == 0).all(), "inactive slot emits pad"
 
 
+def test_prompt_exactly_at_bucket_boundary(lm):
+    """Edge the PR 2 suite skipped: prompts whose length EQUALS a prefill
+    bucket (no pad tail at all) ride the engine next to an off-boundary
+    prompt, and both streams equal their solo generates — the boundary
+    must select the exact-fit bucket, not overflow to the next one."""
+    p8 = _prompts(1, s=8, seed=19)       # == bucket 8
+    p16 = _prompts(1, s=16, seed=21)     # == bucket 16 (the largest)
+    p5 = _prompts(1, s=5, seed=23)[:, :5]
+    submits = [dict(prompt=p8[0], max_new_tokens=6),
+               dict(prompt=p16[0], max_new_tokens=5, arrival_block=1),
+               dict(prompt=p5[0], max_new_tokens=6, arrival_block=1)]
+    _, ids, comps = _run_engine(lm, True, submits)
+    for i, (prompt, n) in enumerate(((p8, 6), (p16, 5), (p5, 6))):
+        g = lm.generate(prompt, max_new_tokens=n)
+        assert comps[ids[i]].tokens.tolist() == g.tokens[0].tolist(), i
+
+
 def test_engine_submit_validation(lm):
     eng = ServeEngine(lm, block_steps=K, top_k=None, top_p=None)
     p = _prompts(1)[0]

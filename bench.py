@@ -1027,15 +1027,18 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     fused = lm.compile_session_decode_fused(fused_steps)
     lm.insert(session, np.arange(max_batch), prompts)
     state = (session.cache, jnp.zeros((max_batch, 1), jnp.int32),
-             jax.random.key(0), jnp.asarray(session.lengths, jnp.int32),
+             jax.random.split(jax.random.key(0), max_batch),
+             jnp.zeros((max_batch,), jnp.int32),
+             jnp.asarray(session.lengths, jnp.int32),
              jnp.ones((max_batch,), bool), jnp.zeros((max_batch,), bool),
              jnp.full((max_batch,), -1, jnp.int32),
              jnp.zeros((max_batch,), jnp.float32), jnp.ones((max_batch,), bool))
 
-    def blk(cache, tok, rng, lengths, active, done, eos, temp, greedy):
-        toks, cache, tok, rng, lengths, done = fused(
-            lm.params, cache, tok, rng, lengths, active, done, eos, temp, greedy)
-        return toks, cache, tok, rng, lengths, active, done, eos, temp, greedy
+    def blk(cache, tok, keys, counts, lengths, active, done, eos, temp, greedy):
+        toks, cache, tok, lengths, done = fused(
+            lm.params, cache, tok, keys, counts, lengths, active, done, eos,
+            temp, greedy)
+        return toks, cache, tok, keys, counts, lengths, active, done, eos, temp, greedy
 
     st = blk(*state)
     int(np.asarray(st[0])[0, 0])  # warm + sync
@@ -1160,6 +1163,68 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     except Exception as e:  # noqa: BLE001 — paged section additive, never fatal
         out["serve_paged_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # --- chunked prefill: decode stall under a long-prompt insert (ISSUE 4
+    # tentpole evidence). A heavy-tailed trace (every 4th prompt is a
+    # 256-token LONG prompt amid 64-token traffic) drives the same engine
+    # twice: unchunked — each long one-shot insert stalls every live token
+    # stream for the whole prefill — vs chunked at 128 tokens/round.
+    # Reported: inter-token-latency percentiles under load (chunked run)
+    # and the worst decode stall a SHORT request suffers (max inter-token
+    # wall gap), both modes; the chunked stall must drop toward the
+    # no-insert per-block time.
+    try:
+        long_len = 2 * prompt_len
+        lm_i = CausalLM(lcfg, model.params, LlamaForCausalLM,
+                        buckets=(64, prompt_len, long_len),
+                        max_batch=max_batch)
+        lm_i.compile()
+        itrace = synthetic_trace(
+            10, 32000, prompt_lens=(64,), max_new_tokens=48,
+            mean_interarrival_blocks=0.5,
+            long_prompt_frac=0.25, long_prompt_len=long_len, seed=2)
+        chunk = prompt_len
+        reports = {}
+        for chunked in (0, chunk):
+            # warm every program either schedule can hit outside the timed
+            # window: insert widths per bucket, the fused block, and (for
+            # the chunked run) the 1-row chunk-extend at chunk width
+            for rows in range(1, max_batch + 1):
+                for b in (64, prompt_len, long_len):
+                    lm_i._insert_programs(rows, b)
+            if chunked:
+                lm_i._chunk_extend_programs(1, chunk)
+            warm = ServeEngine(lm_i, block_steps=fused_steps,
+                               prefill_chunk_tokens=chunked)
+            for item in itrace[:max_batch]:
+                warm.submit(item["prompt"][:64], 2)
+            warm.run()
+            eng_i = ServeEngine(lm_i, block_steps=fused_steps,
+                                prefill_chunk_tokens=chunked)
+            reports[chunked] = run_trace(eng_i, itrace)
+
+        def short_stall(rep):
+            gaps = [r["max_itl_gap_ms"] for r in rep["per_request"]
+                    if r["prompt_len"] < long_len]
+            return round(max(gaps), 2) if gaps else None
+
+        out["serve_itl_p50_ms"] = reports[chunk]["itl_p50_ms"]
+        out["serve_itl_p99_ms"] = reports[chunk]["itl_p99_ms"]
+        out["serve_itl_p99_ms_unchunked"] = reports[0]["itl_p99_ms"]
+        out["serve_decode_stall_ms_longprompt"] = short_stall(reports[0])
+        out["serve_decode_stall_ms_longprompt_chunked"] = short_stall(
+            reports[chunk])
+        out["serve_prefill_chunk_tokens"] = chunk
+        out["serve_chunk_program_calls"] = reports[chunk]["chunk_program_calls"]
+        out["serve_itl_basis"] = (
+            f"10-request trace, 64-tok prompts with every 4th a "
+            f"{long_len}-tok long prompt, 48 new tokens each, "
+            f"{max_batch} slots, fused K={fused_steps}; stall = max "
+            f"inter-token wall gap over SHORT requests; chunked = "
+            f"{chunk}-tok prefill chunks, warmed both runs")
+        del lm_i, warm, eng_i
+    except Exception as e:  # noqa: BLE001 — chunked section additive, never fatal
+        out["serve_chunked_error"] = f"{type(e).__name__}: {e}"[:120]
+
     del lm, model, session, fused, st, cache
     gc.collect()
     return out
@@ -1188,7 +1253,11 @@ HEADLINE_KEYS = (
     "serve_cold_ttft_ms", "serve_prefix_hit_ttft_ms",
     "serve_prefix_hit_ttft_ratio", "paged_hbm_bytes_vs_slab",
     "serve_tokens_per_sec_paged",
+    "serve_itl_p50_ms", "serve_itl_p99_ms", "serve_itl_p99_ms_unchunked",
+    "serve_decode_stall_ms_longprompt",
+    "serve_decode_stall_ms_longprompt_chunked",
     "ttft_error", "spec_bench_error", "serve_bench_error", "serve_paged_error",
+    "serve_chunked_error",
 )
 
 

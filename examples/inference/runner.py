@@ -400,6 +400,7 @@ def cmd_serve(args) -> None:
     lm.compile()
     engine = ServeEngine(lm, block_steps=args.fused_steps,
                          fused=not args.stepwise,
+                         prefill_chunk_tokens=args.prefill_chunk_tokens,
                          rng=jax.random.key(args.seed))
     prompt_lens = ((8, 12, 16) if args.tiny
                    else (64, min(128, args.prompt_len), args.prompt_len))
@@ -408,6 +409,8 @@ def cmd_serve(args) -> None:
         max_new_tokens=args.max_new_tokens,
         mean_interarrival_blocks=args.mean_interarrival,
         shared_prefix_len=args.shared_prefix_len,
+        long_prompt_frac=args.long_prompt_frac,
+        long_prompt_len=args.long_prompt_len,
         seed=args.seed,
     )
     # warm every program the trace will hit (all insert widths per bucket +
@@ -419,7 +422,9 @@ def cmd_serve(args) -> None:
             for rows in range(1, lm.max_batch + 1):
                 lm._insert_programs(rows, lm._bucket_for(s))
     warm = ServeEngine(lm, block_steps=args.fused_steps,
-                       fused=not args.stepwise, rng=jax.random.key(args.seed))
+                       fused=not args.stepwise,
+                       prefill_chunk_tokens=args.prefill_chunk_tokens,
+                       rng=jax.random.key(args.seed))
     for item in trace[: min(len(trace), lm.max_batch)]:
         warm.submit(item["prompt"], 2)
     warm.run()
@@ -565,6 +570,19 @@ def main(argv=None) -> None:
         p.add_argument("--stepwise", action="store_true",
                        help="serve: per-token dispatch baseline (same "
                             "schedule, bit-identical tokens)")
+        p.add_argument("--prefill_chunk_tokens", type=int, default=0,
+                       help="serve: C>0 prefills prompts longer than C in "
+                            "C-token chunks interleaved with decode blocks "
+                            "(stall-free batching; bit-identical streams). "
+                            "Smaller C tightens live streams' inter-token "
+                            "latency, larger C shortens new-request TTFT")
+        p.add_argument("--long_prompt_frac", type=float, default=0.0,
+                       help="serve: fraction of trace requests carrying a "
+                            "long prompt (heavy-tailed interference "
+                            "workload; see --long_prompt_len)")
+        p.add_argument("--long_prompt_len", type=int, default=0,
+                       help="serve: prompt length of the long-tail requests "
+                            "when --long_prompt_frac > 0")
         p.add_argument("--num_requests", type=int, default=8,
                        help="serve: synthetic arrival-trace length")
         p.add_argument("--mean_interarrival", type=float, default=0.5,

@@ -195,6 +195,7 @@ class CausalLM:
         self._insert_prefill = {}   # (rows, bucket) -> right-sized prefill
         self._insert_scatter = {}   # rows -> donated row-scatter program
         self._paged_insert = {}     # (rows, bucket) -> donated paged insert
+        self._chunk_extend = {}     # (rows, bucket) -> donated chunk-prefill extend
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
 
@@ -345,12 +346,20 @@ class CausalLM:
         :meth:`compile_decode_fused`, with the per-slot serving state carried
         ON-DEVICE so the whole slot pool advances K tokens per dispatch.
 
-        The scan body carries ``(cache, tok, rng, lengths, done)`` and closes
-        over the block-invariant ``active``/``eos_ids``/``temperature``/
-        ``greedy`` row arrays (membership and per-request samplers change
-        only at block boundaries, where the scheduler passes refreshed
-        arrays — they ride the dispatch, costing no extra host op):
+        The scan body carries ``(cache, tok, counts, lengths, done)`` and
+        closes over the block-invariant ``slot_keys``/``active``/``eos_ids``/
+        ``temperature``/``greedy`` row arrays (membership and per-request
+        samplers change only at block boundaries, where the scheduler passes
+        refreshed arrays — they ride the dispatch, costing no extra host op):
 
+        * per-REQUEST rng: each slot carries its request's key
+          (``fold_in(engine base, request_id)``, a ``(b,)`` typed key array)
+          and a per-slot generated-token counter; step i samples row j under
+          ``fold_in(slot_keys[j], counts[j])`` via the per-row branch of
+          :class:`SlotSampler`. A request's t-th token therefore draws from
+          ``fold_in(request_key, t)`` REGARDLESS of schedule — what makes
+          chunked-prefill admission (which shifts every subsequent block)
+          bit-identical to one-shot admission even for sampled requests;
         * emission: the token emitted at step i is frozen to ``pad_token_id``
           for rows that were done OR inactive BEFORE step i (the stepwise
           engine's record order); the raw sample still feeds step i+1,
@@ -365,14 +374,15 @@ class CausalLM:
           program safe even against a buggy/hostile driver.
 
         Every latch is a pure function of the EMITTED tokens and the block
-        inputs, so a host scheduler can mirror ``lengths``/``done`` exactly
-        from the single per-block fetch — one program call + one fetch per K
-        tokens for the whole pool.
+        inputs, so a host scheduler can mirror ``lengths``/``done``/
+        ``counts`` exactly from the single per-block fetch — one program
+        call + one fetch per K tokens for the whole pool.
 
-        Returns the compiled program ``(params, cache, tok (b,1), rng,
-        lengths (b,), active (b,), done (b,), eos_ids (b,), temperature (b,),
-        greedy (b,)) -> (tokens (steps, b), cache, next_tok, rng, lengths,
-        done)``. Cached per ``(steps, slot_sampler, pad)``.
+        Returns the compiled program ``(params, cache, tok (b,1), slot_keys
+        (b,) keys, counts (b,), lengths (b,), active (b,), done (b,),
+        eos_ids (b,), temperature (b,), greedy (b,)) -> (tokens (steps, b),
+        cache, next_tok, lengths, done)``. Cached per ``(steps,
+        slot_sampler, pad)``.
         """
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
@@ -382,11 +392,11 @@ class CausalLM:
             return self._session_fused[key]
         max_len = self.config.max_seq_len
 
-        def fused_fn(params, cache, tok, rng, lengths, active, done,
-                     eos_ids, temperature, greedy):
+        def fused_fn(params, cache, tok, slot_keys, counts, lengths, active,
+                     done, eos_ids, temperature, greedy):
             def body(carry, _):
-                cache, tok, rng, lengths, done = carry
-                rng, sub = jax.random.split(rng)
+                cache, tok, counts, lengths, done = carry
+                sub = jax.vmap(jax.random.fold_in)(slot_keys, counts)
                 logits, mut = self.model.apply(
                     {"params": self._resolve(params), "cache": cache}, tok,
                     mutable=["cache"]
@@ -394,19 +404,22 @@ class CausalLM:
                 nxt = slot_sampler(logits[:, 0, :], sub, temperature, greedy)
                 out = jnp.where(done | ~active, jnp.int32(pad_token_id), nxt)
                 done = done | (active & (eos_ids >= 0) & (nxt == eos_ids))
+                counts = counts + 1
                 lengths = lengths + 1
                 done = done | (active & (lengths + 1 >= max_len))
-                return (mut["cache"], nxt[:, None], rng, lengths, done), out
+                return (mut["cache"], nxt[:, None], counts, lengths, done), out
 
-            (cache, tok, rng, lengths, done), toks = jax.lax.scan(
-                body, (cache, tok, rng, lengths, done), None, length=steps)
-            return toks, self._replicate_out(cache), tok, rng, lengths, done
+            (cache, tok, counts, lengths, done), toks = jax.lax.scan(
+                body, (cache, tok, counts, lengths, done), None, length=steps)
+            return toks, self._replicate_out(cache), tok, lengths, done
 
         b = self.max_batch
         self._session_fused[key] = (
             jax.jit(fused_fn, donate_argnums=(1,))
             .lower(self.params, self._cache_avals(),
-                   jnp.zeros((b, 1), jnp.int32), jax.random.key(0),
+                   jnp.zeros((b, 1), jnp.int32),
+                   jax.random.split(jax.random.key(0), b),
+                   jnp.zeros((b,), jnp.int32),
                    jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
                    jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
                    jnp.ones((b,), jnp.float32), jnp.ones((b,), bool))
@@ -595,6 +608,131 @@ class CausalLM:
                    jnp.zeros((rows,), jnp.int32))
             .compile())
         return self._paged_insert[key]
+
+    def _chunk_extend_programs(self, rows: int, bucket: int):
+        """Lazily compile the CHUNKED-PREFILL extend for ``rows`` slots at
+        chunk width ``bucket`` (contiguous-slab path): ONE donated program
+        that (a) gathers the target slots' cache rows (O(rows) slices, not a
+        whole-cache copy), pinning their ``cache_index`` to ``starts`` so
+        the model writes the chunk at positions ``starts..starts+bucket``
+        and attends it against everything already in the row, (b) runs the
+        decode-mode forward at batch width ``rows``, and (c) scatters the
+        mutated rows back with ``cache_index = new_len`` (the TRUE covered
+        length — the pad tail's garbage writes land beyond it, behind the
+        position mask, exactly like one-shot insert pads).
+
+        Because per-position math is row- and width-local (dense cached
+        attention reduces over the full ``max_seq_len`` key axis in both
+        paths), a prompt prefilled through N chunk extends produces
+        bit-identical KV and last-token logits to the one-shot insert of the
+        whole prompt — the chunked-prefill exactness oracle
+        (tests/test_chunked_prefill.py)."""
+        key = (rows, bucket)
+        if key in self._chunk_extend:
+            return self._chunk_extend[key]
+
+        def extend_fn(params, cache, ids, slots, starts, new_len):
+            def gather(path, leaf):
+                if jax.tree_util.keystr(path).endswith("['cache_index']"):
+                    return jnp.broadcast_to(
+                        starts.astype(leaf.dtype), (leaf.shape[0], rows))
+                picked = [jax.lax.dynamic_slice_in_dim(leaf, slots[i], 1, axis=1)
+                          for i in range(rows)]
+                return jnp.concatenate(picked, axis=1)
+
+            row_cache = jax.tree_util.tree_map_with_path(gather, cache)
+            logits, mut = self.model.apply(
+                {"params": self._resolve(params), "cache": row_cache}, ids,
+                mutable=["cache"])
+
+            def back(path, old, new):
+                if jax.tree_util.keystr(path).endswith("['cache_index']"):
+                    out = old
+                    for i in range(rows):
+                        v = jnp.broadcast_to(new_len[i].astype(old.dtype),
+                                             (old.shape[0], 1))
+                        out = jax.lax.dynamic_update_slice_in_dim(
+                            out, v, slots[i], axis=1)
+                    return out
+                out = old
+                for i in range(rows):
+                    out = jax.lax.dynamic_update_slice_in_dim(
+                        out, jax.lax.dynamic_slice_in_dim(new, i, 1, axis=1),
+                        slots[i], axis=1)
+                return out
+
+            return logits, self._replicate_out(
+                jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
+
+        self._chunk_extend[key] = (
+            jax.jit(extend_fn, donate_argnums=(1,))
+            .lower(self.params, self._cache_avals(),
+                   jnp.zeros((rows, bucket), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32),
+                   jnp.zeros((rows,), jnp.int32))
+            .compile())
+        return self._chunk_extend[key]
+
+    def extend(self, session: "DecodeSession", slot_ids: np.ndarray,
+               chunk_ids: np.ndarray, lengths: np.ndarray,
+               starts: np.ndarray, tables: Optional[np.ndarray] = None
+               ) -> jax.Array:
+        """Chunked-prefill extension: write ``lengths[i]`` new prompt tokens
+        per slot at positions ``starts[i]..starts[i]+lengths[i]`` (the
+        tentpole primitive behind ``ServeEngine(prefill_chunk_tokens=...)``).
+        Unlike :meth:`insert`, the slot's EXISTING KV is kept and extended —
+        the chunk attends against it — and no first-token sample should be
+        drawn until the final chunk. Returns the logits at each row's last
+        real chunk token (meaningful only on a request's final chunk).
+
+        Paged mode reuses the donated paged-insert program (it already
+        prefills at arbitrary ``starts`` through caller-provided block
+        tables — pass ``tables`` covering everything written through this
+        chunk; the engine drives page allocation chunk-by-chunk via
+        ``PagedKVCache.begin/extend/finish_chunked``). Contiguous mode runs
+        the gather/extend/scatter program of :meth:`_chunk_extend_programs`.
+        """
+        if self._decode is None:
+            self.compile()
+        slot_ids = np.asarray(slot_ids, np.int32)
+        self._check_slots(slot_ids)
+        rows, s = chunk_ids.shape
+        if rows != len(slot_ids):
+            raise ValueError(f"{rows} chunks for {len(slot_ids)} slots")
+        lengths = np.asarray(lengths, np.int32)
+        starts = np.asarray(starts, np.int32)
+        if (lengths < 1).any():
+            raise ValueError(f"empty chunk in {lengths.tolist()}")
+        new_len = starts + lengths
+        if int(new_len.max()) >= self.config.max_seq_len:
+            raise ValueError(
+                f"chunk end {int(new_len.max())} leaves no decode room in "
+                f"max_seq_len {self.config.max_seq_len}")
+        bucket = self._bucket_for(s)
+        ids = np.zeros((rows, bucket), np.int32)
+        ids[:, :s] = chunk_ids
+        if self.paged:
+            if session.paged is None:
+                raise ValueError("paged CausalLM needs a session from "
+                                 "start_session() (no paged state attached)")
+            if tables is None:
+                raise ValueError("paged extend needs per-row block tables")
+            prog = self._paged_insert_programs(rows, bucket)
+            logits, cache = prog(
+                self.params, session.cache, jnp.asarray(ids),
+                jnp.asarray(tables, jnp.int32), jnp.asarray(slot_ids),
+                jnp.asarray(starts), jnp.asarray(new_len))
+        else:
+            prog = self._chunk_extend_programs(rows, bucket)
+            logits, cache = prog(
+                self.params, session.cache, jnp.asarray(ids),
+                jnp.asarray(slot_ids), jnp.asarray(starts),
+                jnp.asarray(new_len))
+        session.cache = cache
+        session.lengths[slot_ids] = new_len
+        last = jnp.asarray(np.maximum(lengths - 1, 0))
+        return logits[jnp.arange(rows), last]
 
     def _insert_paged(self, session: "DecodeSession", slot_ids: np.ndarray,
                       prompt_ids: np.ndarray, lengths: np.ndarray,

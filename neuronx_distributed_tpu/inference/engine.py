@@ -20,16 +20,35 @@ Scheduler responsibilities (all host-side, between blocks):
 * bucketed prefill batching — queued requests sharing a prefill bucket are
   admitted together through ONE right-sized ``insert`` (prefill width =
   number of admitted prompts, scatter cost O(admitted rows));
+* CHUNKED prefill (``prefill_chunk_tokens > 0``) — a prompt longer than the
+  chunk budget is admitted into a slot but prefilled across scheduling
+  rounds, at most ``prefill_chunk_tokens`` prompt tokens per round
+  (``CausalLM.extend``), INTERLEAVED with the decode blocks of every active
+  slot: Sarathi-Serve's stall-free batching on top of the Orca-style
+  iteration-level scheduling above. A one-shot insert of a long prompt
+  stalls every live token stream for the whole prefill; chunking bounds the
+  per-round prefill work, so inter-token latency during an insert stays
+  near the no-insert baseline (``bench_serving``'s
+  ``serve_decode_stall_ms_longprompt`` pair measures exactly this). No
+  token is emitted until the final chunk; in paged mode pages are allocated
+  chunk-by-chunk (``PagedKVCache.begin/extend/finish_chunked``) and pool
+  pressure mid-prefill rolls the whole admission back atomically;
 * retire-on-EOS / budget / cache-room — finished slots are retired at block
-  boundaries and immediately reusable;
+  boundaries and immediately reusable; ``cancel`` retires a request in ANY
+  state (queued / mid-prefill / decoding);
 * per-request samplers — greedy flag + temperature ride per-slot device
   arrays into the compiled program (:class:`SlotSampler`); ``top_k``/
-  ``top_p`` are engine-wide statics validated at submit.
+  ``top_p`` are engine-wide statics validated at submit;
+* per-request rng — request r's t-th token draws from
+  ``fold_in(fold_in(base, r), t)``, so a sampled stream is a pure function
+  of (prompt, params, base key, request id): bit-identical across fused vs
+  stepwise, paged vs contiguous, AND chunked vs one-shot admission, no
+  matter how the schedules interleave.
 
 Exactness invariant: with ``fused=False`` the engine replays the identical
 schedule through per-token ``step()`` dispatches (same admission cadence,
-same rng fold-in, same sampler math), and both modes emit token streams
-bit-identical to each other and — for greedy requests — to a solo
+same per-request keys, same sampler math), and both modes emit token
+streams bit-identical to each other and — for greedy requests — to a solo
 ``CausalLM.generate`` of the same prompt.
 """
 
@@ -44,8 +63,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuronx_distributed_tpu.inference.causal_lm import CausalLM
-from neuronx_distributed_tpu.inference.paged_cache import PagePoolExhausted
+from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
+from neuronx_distributed_tpu.inference.paged_cache import (
+    ChunkedPrefill,
+    PagePoolExhausted,
+)
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
 
@@ -65,6 +87,7 @@ class Request:
     arrival_block: int = 0
     submit_block: int = 0           # block counter when submitted
     start_block: Optional[int] = None
+    first_token_block: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -74,6 +97,24 @@ class Completion:
     prompt_len: int
     queue_blocks: int               # admission wait (blocks, virtual time)
     decode_blocks: int              # blocks from insert to retirement
+    ttft_blocks: int = 0            # arrival -> first token (virtual blocks)
+    # wall perf_counter stamp per emitted token (the block fetch that
+    # surfaced it) — what the inter-token-latency report is computed from
+    token_ts: Optional[np.ndarray] = None
+    cancelled: bool = False
+
+
+@dataclasses.dataclass
+class _PrefillInFlight:
+    """Host state of one chunked admission: the slot is claimed (not free)
+    but decode-inactive until the final chunk lands and its first token is
+    sampled. ``chunk`` carries the paged page bookkeeping (None on the
+    contiguous slab)."""
+
+    req: Request
+    slot: int
+    written: int                    # prompt tokens in KV (incl. reused prefix)
+    chunk: Optional[ChunkedPrefill] = None
 
 
 class ServeEngine:
@@ -87,6 +128,19 @@ class ServeEngine:
     cache rows longer) and (b) over-generates up to K-1 discarded tokens per
     finished request. K ~ 8-16 is the sweet spot on the measured 3.8-6.7 ms
     dispatch floor.
+
+    ``prefill_chunk_tokens`` is the stall-free-batching knob: 0 keeps
+    one-shot admission (a long prompt's whole prefill runs between two
+    decode blocks — every live stream stalls for it); C > 0 prefills any
+    prompt longer than C across rounds, at most C prompt tokens per round,
+    between the pool's decode blocks. Smaller C tightens the inter-token
+    latency bound on live streams but stretches the new request's TTFT (its
+    prompt needs ceil(len/C) rounds, each also paying a K-token decode
+    block) — the TTFT-vs-ITL tradeoff the README documents. Chunking also
+    lifts the bucket ceiling: a prompt longer than the largest prefill
+    bucket is serveable chunked (each chunk rides its own bucket), as long
+    as it still fits the cache room. Token streams are bit-identical to
+    one-shot admission in every mode (the per-request rng contract).
     """
 
     def __init__(
@@ -98,14 +152,25 @@ class ServeEngine:
         top_p: Optional[float] = None,
         pad_token_id: int = 0,
         rng: Optional[jax.Array] = None,
+        prefill_chunk_tokens: int = 0,
     ):
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got {prefill_chunk_tokens}")
+        if prefill_chunk_tokens > lm.buckets[-1]:
+            raise ValueError(
+                f"prefill_chunk_tokens {prefill_chunk_tokens} exceeds the "
+                f"largest prefill bucket {lm.buckets[-1]} (each chunk must "
+                f"ride a compiled bucket)")
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
         self.slot_sampler = SlotSampler(top_k=top_k, top_p=top_p)
         self.pad_token_id = int(pad_token_id)
+        # base key: request r's token t draws from fold_in(fold_in(rng, r), t)
         self.rng = rng if rng is not None else jax.random.key(0)
         if lm._decode is None:
             lm.compile()
@@ -114,6 +179,7 @@ class ServeEngine:
         self.queue: deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * b
         self._out: Dict[int, List[int]] = {}
+        self._out_ts: Dict[int, List[float]] = {}
         self.completed: List[Completion] = []
         # host mirrors of the on-device per-slot state (exact by design:
         # every device latch is a pure function of the fetched emissions)
@@ -124,6 +190,13 @@ class ServeEngine:
         self._temp = np.zeros((b,), np.float32)
         self._greedy = np.ones((b,), bool)
         self._tok = np.zeros((b,), np.int32)
+        # per-slot request keys + generated-token counters (the device
+        # samples row j's step under fold_in(slot_keys[j], counts[j]))
+        self._slot_keys = jax.random.split(self.rng, b)
+        self._gen_counts = np.zeros((b,), np.int32)
+        # chunked-prefill state: slot -> in-flight admission, FIFO order
+        self._prefilling: Dict[int, _PrefillInFlight] = {}
+        self._prefill_q: deque[int] = deque()
         self._next_id = 0
         self.blocks = 0
         # paged mode (lm built with page_size): admission additionally
@@ -132,7 +205,9 @@ class ServeEngine:
         self.paged = bool(getattr(lm, "paged", False))
         self.stats = {"blocks": 0, "decode_blocks": 0, "inserts": 0,
                       "inserted_requests": 0, "program_calls": 0,
-                      "host_fetches": 0, "deferred_admissions": 0}
+                      "host_fetches": 0, "deferred_admissions": 0,
+                      "chunk_program_calls": 0, "prefill_chunk_tokens_done": 0,
+                      "prefill_aborts": 0, "cancelled": 0}
 
     # --- submission ------------------------------------------------------
 
@@ -154,7 +229,11 @@ class ServeEngine:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds serveable cache room {room}")
-        if prompt.size > self.lm.buckets[-1]:
+        chunked = (self.prefill_chunk_tokens
+                   and prompt.size > self.prefill_chunk_tokens)
+        if prompt.size > self.lm.buckets[-1] and not chunked:
+            # chunked admission lifts the bucket ceiling: each chunk rides
+            # its own (<= prefill_chunk_tokens) bucket
             raise ValueError(
                 f"prompt length {prompt.size} exceeds largest bucket "
                 f"{self.lm.buckets[-1]}")
@@ -187,17 +266,63 @@ class ServeEngine:
         self.queue.append(req)
         return req.request_id
 
+    def cancel(self, request_id: int) -> bool:
+        """Retire a request in whatever state it is in (client disconnect):
+        queued → dropped; mid-chunked-prefill → slot freed, pages rolled
+        back atomically, no completion; decoding → retired NOW with a
+        partial (``cancelled=True``) completion. Returns False when the id
+        is unknown or already completed."""
+        for i, r in enumerate(self.queue):
+            if r.request_id == request_id:
+                del self.queue[i]
+                self.stats["cancelled"] += 1
+                return True
+        for slot, st in list(self._prefilling.items()):
+            if st.req.request_id == request_id:
+                self._abort_prefill(slot, requeue=False)
+                self.stats["cancelled"] += 1
+                return True
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.request_id == request_id:
+                self.lm.retire(self.session, np.asarray([slot], np.int32))
+                ts = self._out_ts.pop(req.request_id, [])
+                self.completed.append(Completion(
+                    request_id=req.request_id,
+                    tokens=np.asarray(self._out.pop(req.request_id), np.int64),
+                    prompt_len=req.prompt.size,
+                    queue_blocks=max((req.start_block or 0) - req.arrival_block, 0),
+                    decode_blocks=self.blocks - (req.start_block or 0),
+                    ttft_blocks=max((req.first_token_block or self.blocks)
+                                    - req.arrival_block, 0),
+                    token_ts=np.asarray(ts, np.float64),
+                    cancelled=True,
+                ))
+                self.slots[slot] = None
+                self._active[slot] = False
+                self._done[slot] = False
+                self.stats["cancelled"] += 1
+                return True
+        return False
+
     # --- scheduling internals -------------------------------------------
+
+    def _req_key(self, request_id: int) -> jax.Array:
+        return jax.random.fold_in(self.rng, request_id)
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
+
+    def _is_chunked(self, req: Request) -> bool:
+        return bool(self.prefill_chunk_tokens
+                    and req.prompt.size > self.prefill_chunk_tokens)
 
     def _admit(self) -> None:
         """Admit arrived requests into free slots, batching prompts that
         share a prefill bucket into ONE right-sized insert. Requests are
         taken strictly in queue order (no starvation): the head request's
         bucket defines the group, and the scan stops at the first queued
-        request with a different bucket or a later arrival."""
+        request with a different bucket, a later arrival, or a long prompt
+        (which takes the chunked path alone)."""
         while True:
             free = self._free_slots()
             if not free or not self.queue:
@@ -205,10 +330,15 @@ class ServeEngine:
             head = self.queue[0]
             if head.arrival_block > self.blocks:
                 return
+            if self._is_chunked(head):
+                self.queue.popleft()
+                self._begin_chunked(head, free[0])
+                continue
             bucket = self.lm._bucket_for(head.prompt.size)
             group: List[Request] = []
             while (self.queue and len(group) < len(free)
                    and self.queue[0].arrival_block <= self.blocks
+                   and not self._is_chunked(self.queue[0])
                    and self.lm._bucket_for(self.queue[0].prompt.size) == bucket):
                 group.append(self.queue.popleft())
             try:
@@ -249,17 +379,22 @@ class ServeEngine:
                                 reserve_tokens=reserve if self.paged else None)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
-        # first token per inserted request: sampled from the prefill logits
-        # (the same rng fold-in both engine modes and generate() use)
-        self.rng, sub = jax.random.split(self.rng)
+        # first token per inserted request: token index 0 of each request's
+        # own key stream (fold_in(req_key, 0) — the same derivation the
+        # chunked path's final chunk and both decode modes use)
+        keys = jnp.stack([self._req_key(r.request_id) for r in group])
+        sub = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((rows,), jnp.int32))
         temps = np.asarray([r.temperature for r in group], np.float32)
         greedy = np.asarray([r.greedy for r in group], bool)
         first = np.asarray(self.slot_sampler(
             logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))
+        now = time.perf_counter()
         for i, (r, slot) in enumerate(zip(group, slot_ids)):
             r.start_block = self.blocks
+            r.first_token_block = self.blocks
             self.slots[slot] = r
             self._out[r.request_id] = []
+            self._out_ts[r.request_id] = []
             self._lengths[slot] = lens[i]
             self._active[slot] = True
             self._done[slot] = False
@@ -267,9 +402,128 @@ class ServeEngine:
             self._temp[slot] = temps[i]
             self._greedy[slot] = greedy[i]
             self._tok[slot] = int(first[i])
-            self._record(slot, int(first[i]))
+            self._slot_keys = self._slot_keys.at[slot].set(keys[i])
+            self._gen_counts[slot] = 1
+            self._record(slot, int(first[i]), now)
 
-    def _record(self, slot: int, token: int) -> None:
+    # --- chunked prefill (the stall-free admission path) ------------------
+
+    def _begin_chunked(self, req: Request, slot: int) -> None:
+        """Claim ``slot`` for a chunked admission: the slot leaves the free
+        pool NOW (so decode membership is stable) but stays decode-inactive;
+        prefill happens across rounds in :meth:`_advance_prefill`."""
+        chunk = None
+        written = 0
+        if self.paged:
+            chunk = self.session.paged.begin_chunked(
+                req.prompt.tolist(),
+                req.prompt.size + req.max_new_tokens + self.block_steps)
+            written = chunk.start           # prefix hit: skip reused pages
+        req.start_block = self.blocks
+        self.slots[slot] = req
+        self._active[slot] = False
+        self._done[slot] = False
+        self._slot_keys = self._slot_keys.at[slot].set(
+            self._req_key(req.request_id))
+        self._prefilling[slot] = _PrefillInFlight(
+            req=req, slot=slot, written=written, chunk=chunk)
+        self._prefill_q.append(slot)
+
+    def _advance_prefill(self) -> None:
+        """Spend this round's prefill budget: up to ``prefill_chunk_tokens``
+        prompt tokens across the in-flight admissions in FIFO order (a
+        finishing request's tail leaves budget for the next). Pool pressure
+        mid-chunk (paged) rolls the WHOLE admission back atomically and
+        requeues it at the queue head."""
+        budget = self.prefill_chunk_tokens
+        while budget > 0 and self._prefill_q:
+            slot = self._prefill_q[0]
+            st = self._prefilling[slot]
+            req = st.req
+            remaining = req.prompt.size - st.written
+            n = min(budget, remaining)
+            final = n == remaining
+            tables = None
+            if self.paged:
+                pkv = self.session.paged
+                try:
+                    pkv.extend_chunked(st.chunk, st.written + n, final=final)
+                except PagePoolExhausted:
+                    self._abort_prefill(slot, requeue=True)
+                    self.stats["deferred_admissions"] += 1
+                    return
+                tables = pkv.chunk_table(slot, st.chunk)[None]
+            ids = req.prompt[st.written: st.written + n][None]
+            logits = self.lm.extend(
+                self.session, np.asarray([slot], np.int32), ids,
+                np.asarray([n], np.int32), np.asarray([st.written], np.int32),
+                tables=tables)
+            self.stats["chunk_program_calls"] += 1
+            self.stats["prefill_chunk_tokens_done"] += n
+            st.written += n
+            budget -= n
+            if final:
+                self._finish_prefill(slot, st, logits)
+
+    def _finish_prefill(self, slot: int, st: _PrefillInFlight,
+                        logits: jax.Array) -> None:
+        """Final chunk landed: commit pages (paged), sample the request's
+        FIRST token from the last real chunk position (token index 0 of its
+        key stream — bit-identical to what a one-shot insert would have
+        sampled) and hand the slot to the decode pool."""
+        req = st.req
+        assert self._prefill_q[0] == slot
+        self._prefill_q.popleft()
+        del self._prefilling[slot]
+        if self.paged:
+            self.session.paged.finish_chunked(slot, st.chunk)
+        self.stats["inserts"] += 1
+        self.stats["inserted_requests"] += 1
+        key = self._req_key(req.request_id)
+        sub = jax.vmap(jax.random.fold_in)(key[None],
+                                           jnp.zeros((1,), jnp.int32))
+        temps = np.asarray([req.temperature], np.float32)
+        greedy = np.asarray([req.greedy], bool)
+        first = int(np.asarray(self.slot_sampler(
+            logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
+        req.first_token_block = self.blocks
+        self._out[req.request_id] = []
+        self._out_ts[req.request_id] = []
+        self._lengths[slot] = req.prompt.size
+        self.session.active[slot] = True
+        self._active[slot] = True
+        self._done[slot] = False
+        self._eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
+        self._temp[slot] = temps[0]
+        self._greedy[slot] = greedy[0]
+        self._tok[slot] = first
+        self._gen_counts[slot] = 1
+        self._record(slot, first, time.perf_counter())
+
+    def _abort_prefill(self, slot: int, requeue: bool) -> None:
+        """Atomically unwind an in-flight chunked admission: pages released,
+        the slot's DEVICE table reset to scratch (residual decode-block
+        garbage writes must not land in pages the pool re-issues), slot
+        freed. ``requeue`` puts the request back at the queue head — the
+        whole prefill restarts later (chunk work done so far is discarded;
+        correctness never depends on it)."""
+        st = self._prefilling.pop(slot)
+        self._prefill_q.remove(slot)
+        if st.chunk is not None:
+            pkv = self.session.paged
+            pkv.abort_chunked(slot, st.chunk)
+            self.session.cache = _set_block_tables(self.session.cache,
+                                                   pkv.tables)
+        self.slots[slot] = None
+        self._active[slot] = False
+        self.session.lengths[slot] = 0
+        self.session.active[slot] = False
+        self.stats["prefill_aborts"] += 1
+        if requeue:
+            st.req.start_block = None
+            self.queue.appendleft(st.req)
+
+    def _record(self, slot: int, token: int, ts: float) -> None:
         """Append one emitted token to the slot's request; latch done on EOS
         or exhausted budget (the host half of the retire-on-EOS contract)."""
         req = self.slots[slot]
@@ -277,6 +531,7 @@ class ServeEngine:
             return
         out = self._out[req.request_id]
         out.append(token)
+        self._out_ts[req.request_id].append(ts)
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._done[slot] = True
         if len(out) >= req.max_new_tokens:
@@ -284,18 +539,23 @@ class ServeEngine:
 
     def _retire_finished(self) -> None:
         finished = [i for i, r in enumerate(self.slots)
-                    if r is not None and self._done[i]]
+                    if r is not None and i not in self._prefilling
+                    and self._done[i]]
         if not finished:
             return
         self.lm.retire(self.session, np.asarray(finished, np.int32))
         for slot in finished:
             req = self.slots[slot]
+            ts = self._out_ts.pop(req.request_id, [])
             self.completed.append(Completion(
                 request_id=req.request_id,
                 tokens=np.asarray(self._out.pop(req.request_id), np.int64),
                 prompt_len=req.prompt.size,
                 queue_blocks=max((req.start_block or 0) - req.arrival_block, 0),
                 decode_blocks=self.blocks - (req.start_block or 0),
+                ttft_blocks=max((req.first_token_block or 0)
+                                - req.arrival_block, 0),
+                token_ts=np.asarray(ts, np.float64),
             ))
             self.slots[slot] = None
             self._active[slot] = False
@@ -303,29 +563,36 @@ class ServeEngine:
     # --- the block loop --------------------------------------------------
 
     def step_block(self) -> bool:
-        """One scheduling round: admit, advance every slot ``block_steps``
-        tokens, record emissions, retire finished slots. Returns False when
-        there is nothing left to do at the current virtual time."""
+        """One scheduling round: admit, spend the prefill-chunk budget,
+        advance every active slot ``block_steps`` tokens, record emissions,
+        retire finished slots. Returns False when there is nothing left to
+        do at the current virtual time."""
         self._admit()
         self._retire_finished()   # a 1-token budget finishes at insert time
         self._admit()             # ... freeing its slot for queued work now
+        self._advance_prefill()   # <= prefill_chunk_tokens of pending prefill
+        self._retire_finished()   # a 1-token budget may finish at chunk end
         if not self._active.any():
-            if not self.queue:
+            if not self.queue and not self._prefilling:
                 return False
-            # nothing running yet arrivals pending: advance virtual time
+            # nothing decoding, but arrivals or chunked prefill pending:
+            # advance virtual time
             self.blocks += 1
             self.stats["blocks"] += 1
             return True
         toks = self._advance_block()
+        now = time.perf_counter()
         self.stats["blocks"] += 1
         self.stats["decode_blocks"] += 1
         # mirror the device latches from the one fetch (K, b)
         for i in range(self.block_steps):
             row = toks[i]
             for slot, req in enumerate(self.slots):
-                if req is not None and not self._done[slot]:
-                    self._record(slot, int(row[slot]))
+                if (req is not None and slot not in self._prefilling
+                        and not self._done[slot]):
+                    self._record(slot, int(row[slot]), now)
             self._lengths += 1
+            self._gen_counts += 1
         self._tok = toks[-1].astype(np.int32)
         self.blocks += 1
         self._retire_finished()
@@ -339,15 +606,15 @@ class ServeEngine:
         if self.fused:
             fused = self.lm.compile_session_decode_fused(
                 self.block_steps, self.slot_sampler, self.pad_token_id)
-            toks, cache, _nxt, rng, _len, _done = fused(
+            toks, cache, _nxt, _len, _done = fused(
                 self.lm.params, self.session.cache,
-                jnp.asarray(self._tok[:, None]), self.rng,
+                jnp.asarray(self._tok[:, None]), self._slot_keys,
+                jnp.asarray(self._gen_counts),
                 jnp.asarray(self._lengths), jnp.asarray(self._active),
                 jnp.asarray(self._done), jnp.asarray(self._eos),
                 jnp.asarray(self._temp), jnp.asarray(self._greedy))
             self.session.cache = cache
             self.session.lengths = self.session.lengths + self.block_steps
-            self.rng = rng
             self.stats["program_calls"] += 1
             self.stats["host_fetches"] += 1
             return np.asarray(toks)
@@ -357,9 +624,11 @@ class ServeEngine:
         greedy = jnp.asarray(self._greedy)
         tok = self._tok.copy()
         lengths = self._lengths.copy()
+        counts = self._gen_counts.copy()
         max_len = self.lm.config.max_seq_len
         for i in range(self.block_steps):
-            self.rng, sub = jax.random.split(self.rng)
+            sub = jax.vmap(jax.random.fold_in)(self._slot_keys,
+                                               jnp.asarray(counts))
             # direct decode call, NOT lm.step(): step() raises at the cache
             # edge, while the fused program latches done and lets the
             # (dropped) writes run out the block — the stepwise oracle must
@@ -375,6 +644,7 @@ class ServeEngine:
             self.stats["host_fetches"] += 1
             out[i] = np.where(done | ~self._active, self.pad_token_id, nxt)
             done = done | (self._active & (self._eos >= 0) & (nxt == self._eos))
+            counts = counts + 1
             lengths = lengths + 1
             done = done | (self._active & (lengths + 1 >= max_len))
             tok = nxt.astype(np.int32)
@@ -396,6 +666,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     mean_interarrival_blocks: float = 0.5,
                     eos_token_id: Optional[int] = None,
                     shared_prefix_len: int = 0,
+                    long_prompt_frac: float = 0.0,
+                    long_prompt_len: int = 0,
                     seed: int = 0) -> List[dict]:
     """Deterministic synthetic arrival trace (virtual time in blocks):
     exponential inter-arrivals, prompt lengths cycled through
@@ -403,7 +675,18 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     the ``runner.py serve`` entrypoint replay. ``shared_prefix_len > 0``
     prepends ONE common random prefix of that many tokens to every prompt
     (the system-prompt / few-shot-header workload shape the paged engine's
-    prefix cache exists for; prompt_lens then size the per-request tail)."""
+    prefix cache exists for; prompt_lens then size the per-request tail).
+
+    ``long_prompt_frac > 0`` makes the prompt-length distribution heavy-
+    tailed: every ``round(1/frac)``-th request (never the first, so decode
+    traffic is already live when the first long prompt arrives) carries a
+    ``long_prompt_len``-token prompt instead — the prefill/decode
+    interference workload ``prefill_chunk_tokens`` exists for."""
+    if long_prompt_frac < 0 or long_prompt_frac > 1:
+        raise ValueError(f"long_prompt_frac must be in [0, 1], got {long_prompt_frac}")
+    if long_prompt_frac > 0 and long_prompt_len < 1:
+        raise ValueError("long_prompt_frac > 0 needs long_prompt_len >= 1")
+    long_every = round(1 / long_prompt_frac) if long_prompt_frac > 0 else 0
     rs = np.random.RandomState(seed)
     prefix = rs.randint(1, vocab_size, (shared_prefix_len,)).astype(np.int32)
     t = 0.0
@@ -411,6 +694,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     for i in range(num_requests):
         t += rs.exponential(mean_interarrival_blocks)
         s = int(prompt_lens[i % len(prompt_lens)])
+        if long_every and i % long_every == long_every - 1:
+            s = int(long_prompt_len)
         tail = rs.randint(1, vocab_size, (s,)).astype(np.int32)
         trace.append({
             "prompt": np.concatenate([prefix, tail]) if shared_prefix_len else tail,
@@ -424,8 +709,9 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
 def run_trace(engine: ServeEngine, trace: List[dict],
               max_blocks: Optional[int] = None) -> dict:
     """Submit a synthetic trace and drive the engine to completion; returns
-    the serving report (throughput, latency-in-blocks percentiles, host-op
-    accounting) used by ``runner.py serve`` and the bench."""
+    the serving report (throughput, latency-in-blocks percentiles, wall
+    TTFT/inter-token-latency surface, host-op accounting) used by
+    ``runner.py serve`` and the bench."""
     for item in trace:
         engine.submit(item["prompt"], item["max_new_tokens"],
                       eos_token_id=item.get("eos_token_id"),
@@ -435,6 +721,29 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     wall_s = time.perf_counter() - t0
     total_tokens = int(sum(len(c.tokens) for c in completions))
     decode_blocks = max(engine.stats["decode_blocks"], 1)
+    # wall-clock latency surface: per-request TTFT (virtual blocks — wall
+    # arrivals would be backend-racy) and inter-token gaps from the block
+    # fetch stamps. A fused block DELIVERS its K tokens in one fetch, so
+    # the user-experienced inter-token latency is the gap between
+    # successive deliveries — intra-delivery gaps (identical stamps, 0.0)
+    # are excluded. A long-prompt one-shot insert shows up as ONE huge
+    # delivery gap on every concurrently-decoding request; chunked prefill
+    # bounds it, which is what pulls itl_p99 back toward the no-insert
+    # per-block baseline.
+    per_request = []
+    gaps_ms: List[float] = []
+    for c in completions:
+        g = (np.diff(c.token_ts) * 1e3 if c.token_ts is not None
+             and len(c.token_ts) > 1 else np.zeros((0,)))
+        g = g[g > 0.0]
+        gaps_ms.extend(g.tolist())
+        per_request.append({
+            "request_id": c.request_id,
+            "prompt_len": c.prompt_len,
+            "generated": int(len(c.tokens)),
+            "ttft_blocks": c.ttft_blocks,
+            "max_itl_gap_ms": round(float(g.max()), 2) if g.size else 0.0,
+        })
     report = {
         "requests_completed": len(completions),
         "total_generated_tokens": total_tokens,
@@ -459,6 +768,23 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             [c.queue_blocks for c in completions])), 2) if completions else None,
         "decode_blocks_mean": round(float(np.mean(
             [c.decode_blocks for c in completions])), 2) if completions else None,
+        # chunked-prefill surface (zeros when prefill_chunk_tokens == 0)
+        "prefill_chunk_tokens": engine.prefill_chunk_tokens,
+        "chunk_program_calls": engine.stats["chunk_program_calls"],
+        "prefill_chunk_tokens_done": engine.stats["prefill_chunk_tokens_done"],
+        "prefill_aborts": engine.stats["prefill_aborts"],
+        # latency surface
+        "ttft_blocks_mean": round(float(np.mean(
+            [c.ttft_blocks for c in completions])), 2) if completions else None,
+        "ttft_blocks_max": int(max(c.ttft_blocks for c in completions))
+        if completions else None,
+        "itl_p50_ms": round(float(np.percentile(gaps_ms, 50)), 3)
+        if gaps_ms else None,
+        "itl_p99_ms": round(float(np.percentile(gaps_ms, 99)), 3)
+        if gaps_ms else None,
+        "max_itl_gap_ms": round(float(np.max(gaps_ms)), 2)
+        if gaps_ms else None,
+        "per_request": per_request,
     }
     pkv = getattr(engine.session, "paged", None)
     if pkv is not None:

@@ -183,6 +183,23 @@ class RadixPrefixIndex:
 
 
 @dataclasses.dataclass
+class ChunkedPrefill:
+    """In-flight chunked-prefill page state for ONE request (Sarathi-style
+    stall-free admission): pages are allocated INCREMENTALLY as chunks
+    extend coverage, so a long prompt never has to find its whole footprint
+    free at once — and an abort (pool pressure mid-prefill, client cancel)
+    rolls every hold back atomically. ``start`` is the page-aligned reused
+    prefix length (chunk prefill begins there); ``owned`` grows per
+    :meth:`PagedKVCache.extend_chunked` call."""
+
+    tokens: List[int]
+    reserve_total: int
+    start: int
+    shared: List[int]
+    owned: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class InsertPlan:
     """One admission's page layout: ``table`` is the full block-table row
     (shared pages, then owned pages, scratch fill), ``start`` the page-
@@ -302,6 +319,104 @@ class PagedKVCache:
         pages = self._slot_pages.pop(slot, None)
         if pages:
             self.allocator.release(pages)
+        self.tables[slot] = self.scratch[slot]
+
+    # --- chunked-prefill lifecycle (begin/extend/finish/abort) -----------
+    # The one-shot plan/commit pair above allocates a request's WHOLE page
+    # footprint before any device work; chunked admission instead allocates
+    # per chunk, so prefill of a long prompt interleaves with decode blocks
+    # without ever holding pages it has not yet written. Every path pairs:
+    # begin -> extend* -> finish  |  begin -> extend* -> abort.
+
+    def begin_chunked(self, tokens: Sequence[int],
+                      reserve_total: int) -> ChunkedPrefill:
+        """Open a chunked admission: prefix lookup (the reused pages are
+        retained so mid-prefill LRU eviction cannot free them) but NO owned
+        pages yet — allocation happens per chunk in :meth:`extend_chunked`.
+        Cannot exhaust the pool."""
+        ps = self.page_size
+        plen = len(tokens)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        shared: List[int] = []
+        if self.prefix is not None:
+            self.stats["prefix_queries"] += 1
+            shared = self.prefix.lookup(tokens)[: (plen - 1) // ps]
+            if shared:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += len(shared) * ps
+        self.allocator.retain(shared)
+        return ChunkedPrefill(tokens=list(tokens),
+                              reserve_total=int(reserve_total),
+                              start=len(shared) * ps, shared=list(shared))
+
+    def extend_chunked(self, state: ChunkedPrefill, covered_tokens: int,
+                       final: bool = False) -> None:
+        """Allocate the pages a chunk needs BEFORE its device program runs:
+        coverage grows to ``covered_tokens``; the FINAL chunk additionally
+        covers the request's decode reserve (so a finished prefill can never
+        stall on decode-room pages). Tries LRU eviction of cache-only prefix
+        pages first; raises :class:`PagePoolExhausted` with ``state``
+        untouched — the caller aborts (atomic rollback) and the scheduler
+        retries the whole admission later."""
+        ps = self.page_size
+        total = min(int(covered_tokens), self.max_seq_len)
+        if final:
+            total = min(max(state.reserve_total, len(state.tokens)),
+                        self.max_seq_len)
+        need = -(-total // ps) - len(state.shared) - len(state.owned)
+        if need <= 0:
+            return
+        pages = self.allocator.alloc(need)
+        if pages is None and self.prefix is not None:
+            self.stats["evicted_pages"] += self.prefix.evict(
+                need - self.allocator.available())
+            pages = self.allocator.alloc(need)
+        if pages is None:
+            raise PagePoolExhausted(
+                f"chunked prefill needs {need} pages, "
+                f"{self.allocator.available()} free")
+        state.owned.extend(pages)
+
+    def chunk_table(self, slot: int, state: ChunkedPrefill) -> np.ndarray:
+        """Block-table row for the NEXT chunk program: pages allocated so
+        far, scratch beyond (unwritten positions read garbage behind the
+        position mask; pad-tail garbage writes land in scratch or in owned
+        pages a later chunk overwrites). NOT installed in ``self.tables``
+        until :meth:`finish_chunked` — a neighbour's retire mid-prefill may
+        reset the device row to scratch, and the next chunk program simply
+        re-installs this table."""
+        t = np.full((self.pages_per_slot,), self.scratch[slot], np.int32)
+        pages = state.shared + state.owned
+        t[: len(pages)] = pages
+        return t
+
+    def finish_chunked(self, slot: int, state: ChunkedPrefill) -> None:
+        """Install the completed prefill on ``slot`` and register the
+        prompt's fully-covered pages in the prefix index (registration is
+        deferred to completion so no sharer can ever hit a half-written
+        page). Allocation-free — the final :meth:`extend_chunked` already
+        covered prompt + reserve — so this cannot fail after device work."""
+        self.release(slot)
+        self.tables[slot] = self.chunk_table(slot, state)
+        self._slot_pages[slot] = state.shared + state.owned
+        if self.prefix is not None:
+            n_full = len(state.tokens) // self.page_size
+            self.prefix.register(
+                state.tokens[: n_full * self.page_size],
+                [int(p) for p in self.tables[slot, :n_full]])
+        self.stats["pages_in_use_peak"] = max(
+            self.stats["pages_in_use_peak"], self.allocator.in_use())
+
+    def abort_chunked(self, slot: int, state: ChunkedPrefill) -> None:
+        """Atomic rollback of an in-flight chunked prefill: every hold this
+        admission took (shared retains + owned allocations) is released and
+        the slot's table row points back at scratch, so the caller's device-
+        table refresh isolates any residual writes from pages the pool hands
+        to someone else. Idempotent."""
+        self.allocator.release(state.shared)
+        self.allocator.release(state.owned)
+        state.shared, state.owned = [], []
         self.tables[slot] = self.scratch[slot]
 
     # --- sizing ----------------------------------------------------------

@@ -11,6 +11,32 @@ import jax
 import jax.numpy as jnp
 
 
+def apply_top_k_top_p(logits: jax.Array, top_k: Optional[int],
+                      top_p: Optional[float]) -> jax.Array:
+    """Mask ``logits`` (..., vocab) to the top-k / nucleus-p support (−1e30
+    outside) — the shared pre-categorical transform of :class:`Sampler` and
+    :class:`SlotSampler` (row math must stay IDENTICAL between them, so it
+    lives in one place)."""
+    if top_k is not None:
+        vocab = logits.shape[-1]
+        if top_k > vocab:
+            raise ValueError(f"top_k {top_k} exceeds vocab size {vocab}")
+        # exactly-k keep mask via lax.top_k indices — a >=threshold mask
+        # would admit every logit tied at the k-th value
+        _, idx = jax.lax.top_k(logits, top_k)
+        keep = jnp.any(jnp.arange(vocab) == idx[..., None], axis=-2)
+        logits = jnp.where(keep, logits, -1e30)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; cutoff logit value
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return logits
+
+
 @dataclasses.dataclass(frozen=True)
 class Sampler:
     temperature: float = 1.0
@@ -23,24 +49,7 @@ class Sampler:
         logits = logits.astype(jnp.float32)
         if self.greedy or self.temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / self.temperature
-        if self.top_k is not None:
-            vocab = logits.shape[-1]
-            if self.top_k > vocab:
-                raise ValueError(f"top_k {self.top_k} exceeds vocab size {vocab}")
-            # exactly-k keep mask via lax.top_k indices — a >=threshold mask
-            # would admit every logit tied at the k-th value
-            _, idx = jax.lax.top_k(logits, self.top_k)
-            keep = jnp.any(jnp.arange(vocab) == idx[..., None], axis=-2)
-            logits = jnp.where(keep, logits, -1e30)
-        if self.top_p is not None:
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # smallest set with cumulative prob >= top_p; cutoff logit value
-            keep = cum - probs < self.top_p
-            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
+        logits = apply_top_k_top_p(logits / self.temperature, self.top_k, self.top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -56,21 +65,37 @@ class SlotSampler:
 
     Row math is IDENTICAL to :class:`Sampler` at the same settings (greedy
     row == ``Sampler(greedy=True)``, sampled row == ``Sampler(temperature=t,
-    top_k, top_p)``) and rows are independent under one categorical key, so
-    a request's token stream does not depend on what its slot neighbours
-    sample."""
+    top_k, top_p)``).
+
+    ``key`` may be a single key (every row draws from one batched
+    categorical — the pre-chunked-prefill engine scheme) or a ``(b,)`` key
+    ARRAY: each row then samples under its OWN key via a vmapped
+    categorical, so a row's draw is a pure function of (its logits, its
+    key) — independent of batch width, slot position, and neighbours. That
+    independence is what lets the serving engine derive keys per REQUEST
+    (``fold_in(base, request_id)`` + per-token-index fold-in) and keep
+    sampled streams bit-identical across every schedule that produces the
+    same per-position logits: fused vs stepwise, paged vs contiguous, and
+    chunked vs one-shot prefill."""
 
     top_k: Optional[int] = None
     top_p: Optional[float] = None
 
     def __call__(self, logits: jax.Array, key: jax.Array,
                  temperature: jax.Array, greedy: jax.Array) -> jax.Array:
-        """logits (b, vocab), temperature (b,) f32, greedy (b,) bool -> (b,)."""
-        base = Sampler(top_k=self.top_k, top_p=self.top_p)
+        """logits (b, vocab), key () or (b,) typed keys, temperature (b,)
+        f32, greedy (b,) bool -> (b,)."""
         logits = logits.astype(jnp.float32)
         arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # temperature 0 rows route to argmax; the guard only keeps the
         # sampled branch finite for them (its result is discarded)
         safe_t = jnp.maximum(temperature, 1e-6)[:, None]
-        sampled = base(logits / safe_t, key)
+        scaled = logits / safe_t
+        if getattr(key, "ndim", 0):
+            masked = apply_top_k_top_p(scaled, self.top_k, self.top_p)
+            sampled = jax.vmap(
+                lambda lg, k: jax.random.categorical(k, lg))(masked, key)
+            sampled = sampled.astype(jnp.int32)
+        else:
+            sampled = Sampler(top_k=self.top_k, top_p=self.top_p)(scaled, key)
         return jnp.where(greedy | (temperature <= 0.0), arg, sampled)

@@ -374,9 +374,21 @@ class LlamaAttention(nn.Module):
         idx = ci.value                                            # (b,)
         # unified write: s_new tokens land at SLOTS idx..idx+s_new per slot —
         # covers prefill (idx=0), single-token decode, multi-token
-        # speculative verification chunks, and Medusa tree chunks (reference
-        # CTX/TKG/speculation submodels + scatter_index, model_wrapper.py).
-        # Tree steps decouple the RoPE POSITION (tree depth) from the slot.
+        # speculative verification chunks, Medusa tree chunks (reference
+        # CTX/TKG/speculation submodels + scatter_index, model_wrapper.py),
+        # AND chunked-prefill extends (idx = tokens already written: a
+        # partial-length continuation whose queries attend both the
+        # already-written prefix and, causally, each other). Tree steps
+        # decouple the RoPE POSITION (tree depth) from the slot.
+        #
+        # Partial-length masking contract (what makes chunked prefill exact):
+        # only positions < the row's TRUE length are ever visible — query i
+        # sees key j iff j <= idx + i, and the serving layer resets
+        # cache_index to the covered length after every chunk. A chunk's pad
+        # tail (bucket width > real chunk tokens) therefore writes garbage
+        # K/V only at slots STRICTLY ABOVE every real query position, where
+        # it sits behind the mask exactly like the slab's unwritten zeros
+        # until a later chunk / decode step overwrites it.
         chunk_mask = chunk_positions = None
         if chunk_ctx is not None:
             chunk_mask, chunk_positions = chunk_ctx
@@ -415,8 +427,15 @@ class LlamaAttention(nn.Module):
             all_flat = table[:, lpos // ps] * ps + (lpos % ps)[None, :]
             k_all, v_all = kf[all_flat], vf[all_flat]
         else:
-            ck.value = ck.value.at[rows, slots].set(k.astype(ck.value.dtype))
-            cv.value = cv.value.at[rows, slots].set(v.astype(cv.value.dtype))
+            # mode="drop" pins the out-of-bounds semantics the overflow
+            # latch and late chunked-prefill extends rely on (a chunk whose
+            # pad tail runs past max_seq_len must discard those writes, not
+            # clamp them onto the last slot) — this is jax's default for
+            # scatters, made explicit so the contract can't drift
+            ck.value = ck.value.at[rows, slots].set(
+                k.astype(ck.value.dtype), mode="drop")
+            cv.value = cv.value.at[rows, slots].set(
+                v.astype(cv.value.dtype), mode="drop")
             k_all, v_all = ck.value, cv.value
         ci.value = idx + s_new
         if chunk_mask is not None:

@@ -1,0 +1,61 @@
+"""Shared host-op / dispatch counting fixtures for the serving suites.
+
+The dispatch contracts (<= 2 host ops per fused K-token block; zero
+step-decode calls for fused tails; exactly one chunk-extend dispatch per
+prefill chunk) must be proven by counting COMPILED-PROGRAM invocations
+independently of the engine's self-reported stats. test_serving_engine.py
+and test_paged_cache.py used to re-implement these wrappers inline; the
+chunked-prefill suite made a third copy inevitable, so they live here.
+"""
+
+import contextlib
+
+
+class CallCounter:
+    """Mutable invocation counter shared with the wrapped callable."""
+
+    def __init__(self):
+        self.n = 0
+
+
+@contextlib.contextmanager
+def count_calls(obj, attr):
+    """Count direct invocations of the callable at ``obj.attr`` (e.g. the
+    compiled step-decode program ``lm._decode``), restoring it on exit."""
+    counter = CallCounter()
+    orig = getattr(obj, attr)
+
+    def wrapped(*a, **kw):
+        counter.n += 1
+        return orig(*a, **kw)
+
+    setattr(obj, attr, wrapped)
+    try:
+        yield counter
+    finally:
+        setattr(obj, attr, orig)
+
+
+@contextlib.contextmanager
+def count_factory_calls(obj, attr):
+    """Count invocations of the compiled programs RETURNED by the factory at
+    ``obj.attr`` (e.g. ``lm.compile_session_decode_fused`` — the factory
+    itself is cached and may be consulted once per block; what the dispatch
+    contract bounds is how often the PROGRAM runs)."""
+    counter = CallCounter()
+    orig = getattr(obj, attr)
+
+    def factory(*a, **kw):
+        compiled = orig(*a, **kw)
+
+        def wrapped(*ca, **ckw):
+            counter.n += 1
+            return compiled(*ca, **ckw)
+
+        return wrapped
+
+    setattr(obj, attr, factory)
+    try:
+        yield counter
+    finally:
+        setattr(obj, attr, orig)

@@ -52,7 +52,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_prefix_hit_ttft_ms": 24.0,
                                       "serve_prefix_hit_ttft_ratio": 0.253,
                                       "paged_hbm_bytes_vs_slab": 0.542,
-                                      "serve_tokens_per_sec_paged": 498.0})
+                                      "serve_tokens_per_sec_paged": 498.0,
+                                      "serve_itl_p50_ms": 6.2,
+                                      "serve_itl_p99_ms": 9.8,
+                                      "serve_itl_p99_ms_unchunked": 61.0,
+                                      "serve_decode_stall_ms_longprompt": 58.0,
+                                      "serve_decode_stall_ms_longprompt_chunked": 9.5})
     import neuronx_distributed_tpu.utils.cp_microbench as cpm
     monkeypatch.setattr(cpm, "measure_cp_ratio_isolated", lambda *a, **kw: {
         "cp_vs_sp_throughput": 0.97, "cp_vs_sp_throughput_ici_serial": 0.95,
@@ -98,6 +103,15 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_prefix_hit_ttft_ratio"] == 0.253
     assert h["paged_hbm_bytes_vs_slab"] == 0.542
     assert h["serve_tokens_per_sec_paged"] == 498.0
+    # chunked-prefill keys (ISSUE 4): ITL under load + the long-prompt
+    # decode stall, chunked vs unchunked, on both surfaces — with chunking
+    # beating the one-shot insert on both the p99 and the stall
+    assert d["serve_itl_p99_ms"] == h["serve_itl_p99_ms"] == 9.8
+    assert h["serve_itl_p50_ms"] == 6.2
+    assert h["serve_itl_p99_ms"] < h["serve_itl_p99_ms_unchunked"]
+    assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
+    assert h["serve_decode_stall_ms_longprompt_chunked"] < \
+        h["serve_decode_stall_ms_longprompt"]
     # machine-state record (ISSUE 3 satellite): jax/jaxlib versions + XLA
     # flags land in the SIDECAR for cross-run comparability checks — and
     # stay out of the size-capped headline

@@ -178,6 +178,49 @@ def test_inference_runner_serve_paged_tiny(capsys):
     assert report["kv_hbm_bytes"] > 0 and report["kv_hbm_vs_slab"] > 0
 
 
+def test_inference_runner_serve_chunked_tiny(capsys):
+    """ISSUE 4 CI gate: runner.py serve --prefill_chunk_tokens drives the
+    stall-free chunked-admission path over a heavy-tailed trace (every 2nd
+    prompt long) — requests complete, the fused decode half keeps its
+    dispatch contract, and the chunk + latency report surface is present."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3",
+                 "--prefill_chunk_tokens", "8",
+                 "--long_prompt_frac", "0.5", "--long_prompt_len", "24"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["host_ops_per_block"] == 2.0       # decode half untouched
+    assert report["prefill_chunk_tokens"] == 8
+    assert report["chunk_program_calls"] >= 2 * (24 // 8)
+    assert len(report["per_request"]) == 4
+    assert report["itl_p99_ms"] is not None
+
+
+@pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
+# fast smokes above
+def test_inference_runner_serve_chunked_matches_oneshot(capsys):
+    """--prefill_chunk_tokens replays the same heavy-tailed trace the
+    one-shot engine serves: same completions, same token totals (the
+    bit-identity oracle at the CLI surface; token-level assertions live in
+    test_chunked_prefill.py)."""
+    import runner
+
+    args = ["serve", "--tiny", "--max_batch", "2", "--num_requests", "5",
+            "--max_new_tokens", "8", "--fused_steps", "4",
+            "--long_prompt_frac", "0.34", "--long_prompt_len", "24"]
+    runner.main(args)
+    oneshot = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    runner.main(args + ["--prefill_chunk_tokens", "8"])
+    chunked = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert oneshot["requests_completed"] == chunked["requests_completed"] == 5
+    assert oneshot["total_generated_tokens"] == chunked["total_generated_tokens"]
+    assert chunked["host_ops_per_block"] == oneshot["host_ops_per_block"] == 2.0
+    assert chunked["chunk_program_calls"] > 0 == oneshot["chunk_program_calls"]
+
+
 @pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
 # fast smokes above
 def test_inference_runner_serve_paged_matches_contiguous(capsys):

@@ -123,31 +123,19 @@ def test_session_fused_dispatch_count(lm):
     """The dispatch contract, independently counted: ONE compiled-program
     invocation per K-token block (plus the single fetch — <= 2 host ops),
     matching the engine's self-reported stats."""
+    from tests.helpers import count_factory_calls
+
     p = _prompts(2, seed=9)
-    calls = {"n": 0}
-    orig = lm.compile_session_decode_fused
-
-    def counting(*a, **kw):
-        compiled = orig(*a, **kw)
-
-        def wrapped(*ca, **ckw):
-            calls["n"] += 1
-            return compiled(*ca, **ckw)
-
-        return wrapped
-
-    lm.compile_session_decode_fused = counting
-    try:
+    with count_factory_calls(lm, "compile_session_decode_fused") as calls:
         eng, ids, comps = _run_engine(
             lm, True, [dict(prompt=p[0], max_new_tokens=10),
                        dict(prompt=p[1], max_new_tokens=7, arrival_block=1)])
-    finally:
-        lm.compile_session_decode_fused = orig
-    assert calls["n"] == eng.stats["decode_blocks"] >= 2
-    assert eng.stats["program_calls"] == eng.stats["host_fetches"] == calls["n"]
+    assert calls.n == eng.stats["decode_blocks"] >= 2
+    assert eng.stats["program_calls"] == eng.stats["host_fetches"] == calls.n
     rep_ops = (eng.stats["program_calls"] + eng.stats["host_fetches"]) \
         / eng.stats["decode_blocks"]
     assert rep_ops == 2.0
+    assert eng.stats["chunk_program_calls"] == 0   # no chunking requested
     # and the counted path produced the uncounted path's tokens
     g0 = lm.generate(p[0:1], max_new_tokens=10)
     assert comps[ids[0]].tokens.tolist() == g0.tokens[0].tolist()
@@ -203,9 +191,10 @@ def test_session_fused_overflow_guard_freezes_not_wraps(lm):
     lm.insert(session, [0, 1, 2], p)
     # slot 0 reports 2 tokens of room; slot 1 has plenty; slot 2 inactive
     lengths = np.asarray([max_len - 2, 8, 8], np.int32)
-    toks, cache, tok, rng, out_len, done = fused(
+    toks, cache, tok, out_len, done = fused(
         lm.params, session.cache, jnp.zeros((3, 1), jnp.int32),
-        jax.random.key(0), jnp.asarray(lengths),
+        jax.random.split(jax.random.key(0), 3), jnp.ones((3,), jnp.int32),
+        jnp.asarray(lengths),
         jnp.asarray([True, True, False]), jnp.zeros((3,), bool),
         jnp.full((3,), -1, jnp.int32), jnp.zeros((3,), np.float32),
         jnp.ones((3,), bool))
@@ -259,6 +248,14 @@ def test_arrival_trace_report_contract(lm):
     assert report["host_ops_per_block"] == 2.0
     assert report["inserted_requests"] == 5
     assert report["tokens_per_sec"] is not None and report["tokens_per_sec"] > 0
+    # latency surface (ISSUE 4 satellite): per-request TTFT + max ITL gap
+    assert len(report["per_request"]) == 5
+    for pr in report["per_request"]:
+        assert pr["ttft_blocks"] >= 0 and pr["max_itl_gap_ms"] >= 0.0
+    assert report["itl_p50_ms"] is not None
+    assert report["itl_p99_ms"] >= report["itl_p50_ms"]
+    assert report["prefill_chunk_tokens"] == 0
+    assert report["chunk_program_calls"] == 0
 
 
 def test_generate_fused_tail_uses_fused_program(lm):
@@ -266,28 +263,20 @@ def test_generate_fused_tail_uses_fused_program(lm):
     cached tail-sized fused program, not fall back to per-token step decode
     — counted on the step-decode program itself (only a 1-token tail may
     use it)."""
+    from tests.helpers import count_calls
+
     ids = _prompts(2, seed=17)
     ref = lm.generate(ids, max_new_tokens=10)
-    step_calls = {"n": 0}
-    orig = lm._decode
-
-    def counting(*a, **kw):
-        step_calls["n"] += 1
-        return orig(*a, **kw)
-
-    lm._decode = counting
-    try:
+    with count_calls(lm, "_decode") as step_calls:
         # 10 tokens, chunk 4: prefill token + fused(4) + fused(4) + 1-token
         # tail -> exactly ONE step call
         got = lm.generate(ids, max_new_tokens=10, fused_chunk=K)
-        assert step_calls["n"] == 1
-        step_calls["n"] = 0
+        assert step_calls.n == 1
+        step_calls.n = 0
         # 8 tokens, chunk 4: prefill token + fused(4) + fused TAIL of 3 ->
         # ZERO step calls (pre-PR the 3-token tail silently step-decoded)
         got8 = lm.generate(ids, max_new_tokens=8, fused_chunk=K)
-        assert step_calls["n"] == 0
-    finally:
-        lm._decode = orig
+        assert step_calls.n == 0
     np.testing.assert_array_equal(got.tokens, ref.tokens)
     np.testing.assert_array_equal(got8.tokens, ref.tokens[:, :8])
     # the tail program is cached per size

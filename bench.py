@@ -1225,6 +1225,78 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     except Exception as e:  # noqa: BLE001 — chunked section additive, never fatal
         out["serve_chunked_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # --- overload + crash recovery (ISSUE 5 tentpole evidence). Deadlines
+    # live on the virtual block clock (block_time_ms=1.0 -> ms == blocks),
+    # so miss rates are DETERMINISTIC; goodput (in-deadline tokens per wall
+    # second) is the wall-clock half. Capacity here: max_batch slots x
+    # ceil(32/K)=2 blocks/request -> ~2 requests/block; the 2x trace offers
+    # ~4/block, so the unbounded queue's wait grows ~1 block per block and
+    # most late arrivals blow the 4-block completion deadline — while the
+    # bounded queue sheds the overflow EARLY (Rejected + retry_after) and
+    # keeps every admitted request on time.
+    try:
+        mnt = 32
+        deadline_blocks = 4.0       # 2 service blocks + 2 of slack
+
+        def overload_trace(inter, n):
+            return synthetic_trace(
+                n, 32000, prompt_lens=(prompt_len,), max_new_tokens=mnt,
+                mean_interarrival_blocks=inter, deadline_ms=deadline_blocks,
+                seed=3)
+
+        for rows in range(1, max_batch + 1):
+            lm._insert_programs(rows, prompt_len)
+
+        def run_overload(trace, max_queue):
+            warm = ServeEngine(lm, block_steps=fused_steps)
+            for item in trace[:max_batch]:
+                warm.submit(item["prompt"], 2)
+            warm.run()
+            eng = ServeEngine(lm, block_steps=fused_steps,
+                              max_queue=max_queue, shed_policy="deadline")
+            return run_trace(eng, trace)
+
+        r1 = run_overload(overload_trace(0.6, 16), max_queue=max_batch)
+        r2_shed = run_overload(overload_trace(0.25, 32), max_queue=max_batch)
+        r2_noshed = run_overload(overload_trace(0.25, 32), max_queue=None)
+        out["serve_goodput_1x"] = r1["goodput_tokens_per_sec"]
+        out["serve_goodput_2x_overload"] = r2_shed["goodput_tokens_per_sec"]
+        if r1["goodput_tokens_per_sec"]:
+            out["serve_goodput_2x_vs_1x"] = round(
+                r2_shed["goodput_tokens_per_sec"]
+                / r1["goodput_tokens_per_sec"], 3)
+        out["serve_deadline_miss_rate_shed"] = r2_shed["deadline_miss_rate"]
+        out["serve_deadline_miss_rate_noshed"] = r2_noshed["deadline_miss_rate"]
+        out["serve_overload_rejected_2x"] = r2_shed["rejected"]
+        out["serve_overload_expired_2x_noshed"] = r2_noshed["expired"]
+        out["serve_overload_basis"] = (
+            f"{prompt_len}-tok prompts, {mnt} new tokens, {max_batch} slots, "
+            f"fused K={fused_steps}; deadline {deadline_blocks:g} blocks on "
+            f"the virtual clock (block_time_ms=1); 1x = 16 reqs @ 0.6 "
+            f"blocks interarrival, 2x = 32 reqs @ 0.25; shed = "
+            f"max_queue={max_batch}, policy=deadline; miss rate counts "
+            f"rejected + expired + late over all submissions")
+
+        # crash-recovery replay cost: snapshot a mid-trace engine with a
+        # full slot pool, restore into a fresh engine (the restore replays
+        # every in-flight request's prompt+generated through prefill and
+        # resumes bit-identical) — the wall cost of coming back from a kill
+        eng_r = ServeEngine(lm, block_steps=fused_steps)
+        for item in overload_trace(0.0, max_batch):
+            eng_r.submit(item["prompt"], mnt)
+        eng_r.step_block()
+        eng_r.step_block()
+        snap = eng_r.snapshot()
+        t0 = time.perf_counter()
+        eng_restored = ServeEngine.from_snapshot(lm, snap)
+        out["serve_recovery_replay_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        out["serve_recovery_restored_requests"] = \
+            eng_restored.stats["restored_requests"]
+        del eng_r, eng_restored
+    except Exception as e:  # noqa: BLE001 — overload section additive, never fatal
+        out["serve_overload_error"] = f"{type(e).__name__}: {e}"[:120]
+
     del lm, model, session, fused, st, cache
     gc.collect()
     return out
@@ -1256,8 +1328,11 @@ HEADLINE_KEYS = (
     "serve_itl_p50_ms", "serve_itl_p99_ms", "serve_itl_p99_ms_unchunked",
     "serve_decode_stall_ms_longprompt",
     "serve_decode_stall_ms_longprompt_chunked",
+    "serve_goodput_1x", "serve_goodput_2x_overload", "serve_goodput_2x_vs_1x",
+    "serve_deadline_miss_rate_shed", "serve_deadline_miss_rate_noshed",
+    "serve_recovery_replay_ms",
     "ttft_error", "spec_bench_error", "serve_bench_error", "serve_paged_error",
-    "serve_chunked_error",
+    "serve_chunked_error", "serve_overload_error",
 )
 
 

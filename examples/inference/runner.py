@@ -392,16 +392,36 @@ def cmd_serve(args) -> None:
     (``CausalLM.compile_session_decode_fused``). ``--stepwise`` replays the
     identical schedule through per-token dispatches — the baseline the
     fused path is measured against (token streams are bit-identical)."""
+    import os
+
     from neuronx_distributed_tpu.inference.engine import (
         ServeEngine, run_trace, synthetic_trace,
     )
+    from neuronx_distributed_tpu.inference.faults import resolve_fault_plan
 
     lm, cfg = build_model(args)
     lm.compile()
-    engine = ServeEngine(lm, block_steps=args.fused_steps,
-                         fused=not args.stepwise,
-                         prefill_chunk_tokens=args.prefill_chunk_tokens,
-                         rng=jax.random.key(args.seed))
+    eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
+                  prefill_chunk_tokens=args.prefill_chunk_tokens,
+                  max_queue=args.max_queue, shed_policy=args.shed_policy,
+                  block_time_ms=args.block_time_ms)
+    # crash recovery: a snapshot file surviving at startup means the
+    # previous serve died mid-trace — restore it and finish those streams
+    # (bit-identical from the interruption point) instead of starting over
+    if args.snapshot_path and os.path.exists(args.snapshot_path):
+        engine = ServeEngine.from_snapshot(lm, args.snapshot_path, **eng_kw)
+        completions = engine.run()
+        os.remove(args.snapshot_path)
+        print(json.dumps({
+            "recovered": True,
+            "restored_requests": engine.stats["restored_requests"],
+            "requests_completed": len(completions),
+            "total_generated_tokens": int(sum(len(c.tokens)
+                                              for c in completions)),
+        }))
+        return
+    engine = ServeEngine(lm, rng=jax.random.key(args.seed),
+                         faults=resolve_fault_plan(args.fault_plan), **eng_kw)
     prompt_lens = ((8, 12, 16) if args.tiny
                    else (64, min(128, args.prompt_len), args.prompt_len))
     trace = synthetic_trace(
@@ -411,6 +431,8 @@ def cmd_serve(args) -> None:
         shared_prefix_len=args.shared_prefix_len,
         long_prompt_frac=args.long_prompt_frac,
         long_prompt_len=args.long_prompt_len,
+        ttft_deadline_ms=args.ttft_deadline_ms,
+        deadline_ms=args.deadline_ms,
         seed=args.seed,
     )
     # warm every program the trace will hit (all insert widths per bucket +
@@ -428,7 +450,7 @@ def cmd_serve(args) -> None:
     for item in trace[: min(len(trace), lm.max_batch)]:
         warm.submit(item["prompt"], 2)
     warm.run()
-    report = run_trace(engine, trace)
+    report = run_trace(engine, trace, snapshot_path=args.snapshot_path)
     report.update({
         "model": args.model + ("_tiny" if args.tiny else ""),
         "max_batch": lm.max_batch,
@@ -605,6 +627,38 @@ def main(argv=None) -> None:
                        help="serve: prepend one common random prefix of this "
                             "many tokens to every trace prompt (the "
                             "prefix-cache workload shape)")
+        p.add_argument("--ttft_deadline_ms", type=float, default=None,
+                       help="serve: per-request first-token deadline "
+                            "(relative to arrival; converted to the virtual "
+                            "block clock at --block_time_ms per block)")
+        p.add_argument("--deadline_ms", type=float, default=None,
+                       help="serve: per-request completion deadline — a "
+                            "stream past it retires with a partial "
+                            "expired=True completion")
+        p.add_argument("--block_time_ms", type=float, default=1.0,
+                       help="serve: ms of deadline budget one decode block "
+                            "consumes (set to the measured per-block time "
+                            "on hardware; default 1.0 = ms == blocks)")
+        p.add_argument("--max_queue", type=int, default=None,
+                       help="serve: bound the arrived admission backlog — "
+                            "overflow is load-shed with a structured "
+                            "Rejected(retry_after) instead of queueing "
+                            "unboundedly")
+        p.add_argument("--shed_policy", choices=["tail", "deadline"],
+                       default="tail",
+                       help="serve: overflow victim policy (tail = newest "
+                            "arrival, deadline = laxest deadline)")
+        p.add_argument("--snapshot_path", type=str, default=None,
+                       help="serve: crash-recovery snapshot file — written "
+                            "atomically every few blocks, removed on clean "
+                            "drain; if it EXISTS at startup the previous "
+                            "run's in-flight streams are restored and "
+                            "finished bit-identical")
+        p.add_argument("--fault_plan", type=str, default=None,
+                       help="serve: seeded chaos plan (JSON object or path "
+                            "to one): pool_exhaust_prob/pool_storm_len/"
+                            "dispatch_fail_prob/dispatch_max_failures/"
+                            "corrupt_page_prob/seed")
         p.add_argument("--quantize", action="store_true",
                        help="serve int8 weight-only quantized params")
         p.add_argument("--model", choices=["llama", "mixtral", "dbrx"],

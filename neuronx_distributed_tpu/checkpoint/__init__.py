@@ -2,11 +2,13 @@
 ``checkpoint_storage.py`` + ``parallel_layers/checkpointing.py``; SURVEY §5.4)."""
 
 from neuronx_distributed_tpu.checkpoint.core import (  # noqa: F401
+    CheckpointIntegrityError,
     finalize_checkpoint,
     has_checkpoint,
     latest_tag,
     load_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )
 from neuronx_distributed_tpu.checkpoint.storage import (  # noqa: F401
     BaseCheckpointStorage,

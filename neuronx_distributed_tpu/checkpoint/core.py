@@ -29,6 +29,7 @@ resharding tools for the common cases.
 from __future__ import annotations
 
 import atexit
+import hashlib
 import itertools
 import json
 import logging
@@ -53,6 +54,59 @@ _CHECKPOINT_MARKER = "checkpoint"   # save started (reference :136-138)
 _DONE_MARKER = "done"               # save completed (reference :179-182)
 _USER_CONTENT = "user_content.json"
 _PAYLOAD_DIR = "state"
+_MANIFEST = "manifest.json"         # per-shard checksums, written with done
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint's payload does not match its integrity manifest (or the
+    manifest is missing under ``verify=True``): a flipped byte, truncated
+    shard, or lost file — reject loudly instead of restoring garbage
+    params."""
+
+
+def _payload_manifest(storage: BaseCheckpointStorage, tag: str) -> dict:
+    """Per-shard sha256+size over every payload file the writer produced
+    (CheckFreq-style cheap verification: hashing is IO-bound and runs once
+    per save, off the training thread on async saves)."""
+    root = f"{tag}/{_PAYLOAD_DIR}"
+    files = {}
+    for rel in storage.list_files(root):
+        data = storage.read_bytes(f"{root}/{rel}")
+        files[rel] = {"sha256": hashlib.sha256(data).hexdigest(),
+                      "bytes": len(data)}
+    return {"version": 1, "algo": "sha256", "files": files}
+
+
+def verify_checkpoint(storage: BaseCheckpointStorage, tag: str) -> None:
+    """Recompute the payload checksums and compare against the manifest.
+    Raises :class:`CheckpointIntegrityError` naming the first mismatching /
+    missing / extra file."""
+    if not storage.file_exists(f"{tag}/{_MANIFEST}"):
+        raise CheckpointIntegrityError(
+            f"checkpoint {tag!r} has no integrity manifest "
+            f"({_MANIFEST}) — saved by an older writer? re-save or load "
+            f"with verify=False")
+    manifest = json.loads(storage.load_text(f"{tag}/{_MANIFEST}"))
+    expected = manifest.get("files", {})
+    root = f"{tag}/{_PAYLOAD_DIR}"
+    present = set(storage.list_files(root))
+    for rel in sorted(expected):
+        if rel not in present:
+            raise CheckpointIntegrityError(
+                f"checkpoint {tag!r}: payload file {rel!r} is missing")
+        data = storage.read_bytes(f"{root}/{rel}")
+        got = hashlib.sha256(data).hexdigest()
+        if got != expected[rel]["sha256"] or len(data) != expected[rel]["bytes"]:
+            raise CheckpointIntegrityError(
+                f"checkpoint {tag!r}: payload file {rel!r} is corrupted "
+                f"(sha256 {got[:12]}… != manifest "
+                f"{expected[rel]['sha256'][:12]}…, "
+                f"{len(data)} vs {expected[rel]['bytes']} bytes)")
+    extra = present - set(expected)
+    if extra:
+        raise CheckpointIntegrityError(
+            f"checkpoint {tag!r}: unmanifested payload files "
+            f"{sorted(extra)[:4]} (partial overwrite?)")
 
 _executor: Optional[ThreadPoolExecutor] = None
 _pending: list = []
@@ -290,6 +344,12 @@ def save_checkpoint(
                 seq += 1
                 if user_content is not None:
                     storage.save_text(json.dumps(user_content), f"{tag}/{_USER_CONTENT}")
+                # integrity manifest BEFORE the done marker: a tag is only
+                # "complete" once its shards are both durable and
+                # checksummed, so load(verify=True) can reject any byte
+                # flipped between save and restore
+                storage.save_text(json.dumps(_payload_manifest(storage, tag)),
+                                  f"{tag}/{_MANIFEST}")
                 storage.save_text(str(seq), f"{tag}/{_DONE_MARKER}")
             except Exception as e:  # noqa: BLE001 — must still reach the barrier
                 pub_err = e
@@ -398,6 +458,7 @@ def load_checkpoint(
     checkpoint_dir: str,
     tag: Optional[str] = None,
     target: Optional[PyTree] = None,
+    verify: bool = False,
 ) -> Tuple[PyTree, Optional[dict]]:
     """Load the given (or newest completed) tag (reference ``load_checkpoint``
     :739-851, ``latest_if_exists`` semantics).
@@ -405,6 +466,11 @@ def load_checkpoint(
     ``target``: pytree of ``jax.ShapeDtypeStruct`` with ``sharding`` set (or
     concrete arrays) — the state is restored directly into that sharding
     (reshard-on-load). Without a target, numpy arrays are returned.
+
+    ``verify=True`` recomputes every payload shard's checksum against the
+    tag's integrity manifest FIRST and raises
+    :class:`CheckpointIntegrityError` on any mismatch — a flipped byte
+    fails loudly here instead of restoring garbage params.
     """
     import orbax.checkpoint as ocp
 
@@ -418,6 +484,8 @@ def load_checkpoint(
     elif tag not in done:
         raise FileNotFoundError(f"checkpoint tag {tag!r} not complete in {checkpoint_dir}")
 
+    if verify:
+        verify_checkpoint(storage, tag)
     path = storage.abspath(f"{tag}/{_PAYLOAD_DIR}")
     with ocp.PyTreeCheckpointer() as ckptr:
         if target is not None:

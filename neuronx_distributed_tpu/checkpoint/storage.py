@@ -15,11 +15,17 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import shutil
 import time
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 logger = logging.getLogger("nxd")
+
+# retry policy defaults, overridable per storage instance (ctor args) or
+# process-wide via env: NXD_STORAGE_RETRIES / NXD_STORAGE_RETRY_BASE_S
+_DEFAULT_RETRIES = 3
+_DEFAULT_BASE_DELAY = 0.5
 
 
 class BaseCheckpointStorage:
@@ -49,6 +55,15 @@ class BaseCheckpointStorage:
         raise NotImplementedError
 
     def makedirs(self, path: str = "") -> None:
+        raise NotImplementedError
+
+    # integrity-manifest surface: recursive file listing + raw payload
+    # reads, so the checkpoint core can checksum every shard the writer
+    # produced and verify them on load
+    def list_files(self, path: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
         raise NotImplementedError
 
     def abspath(self, path: str = "") -> str:
@@ -94,18 +109,56 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
     def makedirs(self, path: str = "") -> None:
         os.makedirs(self.abspath(path), exist_ok=True)
 
+    def list_files(self, path: str = "") -> List[str]:
+        root = self.abspath(path)
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                out.append(os.path.relpath(os.path.join(dirpath, f), root))
+        return sorted(out)
 
-def _retry(fn: Callable, attempts: int = 3, base_delay: float = 0.5):
-    """Retry with exponential backoff (reference ``_list_with_retry``,
-    checkpoint_storage.py:280 — same policy for every object-store op)."""
+    def read_bytes(self, path: str) -> bytes:
+        with open(self.abspath(path), "rb") as f:
+            return f.read()
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def _env_float(name: str) -> Optional[float]:
+    v = os.environ.get(name)
+    return float(v) if v else None
+
+
+def _retry(fn: Callable, attempts: Optional[int] = None,
+           base_delay: Optional[float] = None, jitter: float = 0.25):
+    """Retry with exponential backoff + jitter (reference
+    ``_list_with_retry``, checkpoint_storage.py:280 — same policy for every
+    object-store op). Jitter desynchronizes the retry waves of a whole
+    training fleet hitting one throttled bucket — without it every host
+    re-fires at the same instant and re-triggers the throttle. Attempts and
+    base delay resolve ctor-arg > env (``NXD_STORAGE_RETRIES`` /
+    ``NXD_STORAGE_RETRY_BASE_S``) > default (3 / 0.5s)."""
+    if attempts is None:
+        attempts = _env_int("NXD_STORAGE_RETRIES") or _DEFAULT_RETRIES
+    if base_delay is None:
+        base_delay = _env_float("NXD_STORAGE_RETRY_BASE_S")
+        if base_delay is None:
+            base_delay = _DEFAULT_BASE_DELAY
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
     for i in range(attempts):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — storage errors are driver-specific
             if i == attempts - 1:
                 raise
-            delay = base_delay * (2 ** i)
-            logger.warning("storage op failed (%s); retry %d/%d in %.1fs",
+            delay = base_delay * (2 ** i) * (1.0 + jitter * random.random())
+            logger.warning("storage op failed (%s); retry %d/%d in %.2fs",
                            e, i + 1, attempts, delay)
             time.sleep(delay)
 
@@ -117,12 +170,22 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
     code). Objects replace files; "directories" are key prefixes; dir
     markers are unnecessary because listing is prefix-based."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, retries: Optional[int] = None,
+                 retry_base_delay: Optional[float] = None):
         super().__init__(url.rstrip("/"))
         import tensorstore as ts
 
         self._ts = ts
-        self._kv = ts.KvStore.open(self.dirname + "/").result()
+        # per-instance retry policy (None falls through to env/defaults at
+        # call time — see _retry)
+        self.retries = retries
+        self.retry_base_delay = retry_base_delay
+        self._kv = self._retry(
+            lambda: ts.KvStore.open(self.dirname + "/").result())
+
+    def _retry(self, fn: Callable):
+        return _retry(fn, attempts=self.retries,
+                      base_delay=self.retry_base_delay)
 
     # --- key helpers ---
     def _key(self, path: str) -> str:
@@ -130,25 +193,26 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
 
     def dir_exists(self, path: str) -> bool:
         prefix = self._key(path) + "/"
-        return bool(_retry(lambda: self._kv.list(
+        return bool(self._retry(lambda: self._kv.list(
             self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")).result()))
 
     def file_exists(self, path: str) -> bool:
-        r = _retry(lambda: self._kv.read(self._key(path)).result())
+        r = self._retry(lambda: self._kv.read(self._key(path)).result())
         return r.state == "value"
 
     def save_text(self, text: str, path: str) -> None:
-        _retry(lambda: self._kv.write(self._key(path), text.encode()).result())
+        self._retry(
+            lambda: self._kv.write(self._key(path), text.encode()).result())
 
     def load_text(self, path: str) -> str:
-        r = _retry(lambda: self._kv.read(self._key(path)).result())
+        r = self._retry(lambda: self._kv.read(self._key(path)).result())
         if r.state != "value":
             raise FileNotFoundError(f"{self.dirname}/{path}")
         return r.value.decode()
 
     def list_dirs(self, path: str = "") -> List[str]:
         prefix = (self._key(path) + "/") if path else ""
-        keys = _retry(lambda: self._kv.list(
+        keys = self._retry(lambda: self._kv.list(
             self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")
             if prefix else self._ts.KvStore.KeyRange()).result())
         dirs = set()
@@ -160,14 +224,27 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
 
     def remove_dir(self, path: str) -> None:
         prefix = self._key(path) + "/"
-        _retry(lambda: self._kv.delete_range(
+        self._retry(lambda: self._kv.delete_range(
             self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")).result())
 
     def remove_file(self, path: str) -> None:
-        _retry(lambda: self._kv.write(self._key(path), None).result())
+        self._retry(lambda: self._kv.write(self._key(path), None).result())
 
     def makedirs(self, path: str = "") -> None:
         pass  # prefixes need no creation
+
+    def list_files(self, path: str = "") -> List[str]:
+        prefix = (self._key(path) + "/") if path else ""
+        keys = self._retry(lambda: self._kv.list(
+            self._ts.KvStore.KeyRange(prefix, prefix[:-1] + "0")
+            if prefix else self._ts.KvStore.KeyRange()).result())
+        return sorted(k.decode()[len(prefix):] for k in keys)
+
+    def read_bytes(self, path: str) -> bytes:
+        r = self._retry(lambda: self._kv.read(self._key(path)).result())
+        if r.state != "value":
+            raise FileNotFoundError(f"{self.dirname}/{path}")
+        return bytes(r.value)
 
     def abspath(self, path: str = "") -> str:
         """Payload paths hand off to orbax/tensorstore: gs://-style URLs pass

@@ -6,10 +6,17 @@ decoding in ``speculative.py``."""
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult  # noqa: F401
 from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     Completion,
+    Rejected,
     Request,
     ServeEngine,
     run_trace,
     synthetic_trace,
+)
+from neuronx_distributed_tpu.inference.faults import (  # noqa: F401
+    DispatchFailed,
+    FaultInjector,
+    FaultPlan,
+    TransientDispatchError,
 )
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
 from neuronx_distributed_tpu.inference.paged_cache import (  # noqa: F401

@@ -768,11 +768,21 @@ class CausalLM:
             ids[i, : suffix[i]] = prompt_ids[i, starts[i]: lengths[i]]
         tables = np.stack([pkv.table_for(int(slot_ids[i]), plans[i])
                            for i in range(rows)])
-        prog = self._paged_insert_programs(rows, bucket)
-        logits, cache = prog(
-            self.params, session.cache, jnp.asarray(ids), jnp.asarray(tables),
-            jnp.asarray(slot_ids), jnp.asarray(starts),
-            jnp.asarray(lengths, np.int32))
+        try:
+            prog = self._paged_insert_programs(rows, bucket)
+            logits, cache = prog(
+                self.params, session.cache, jnp.asarray(ids),
+                jnp.asarray(tables), jnp.asarray(slot_ids),
+                jnp.asarray(starts), jnp.asarray(lengths, np.int32))
+        except Exception:
+            # the program (or its compile) failed AFTER planning took page
+            # holds: release them or the pool leaks one admission's
+            # footprint per failed dispatch — exactly the storm a chaos run
+            # drives. The session cache may be unusable (donation), but the
+            # host allocator must stay consistent for recovery.
+            for p in plans:
+                pkv.rollback(p)
+            raise
         session.cache = cache
         for i in range(rows):
             pkv.commit(int(slot_ids[i]), plans[i],

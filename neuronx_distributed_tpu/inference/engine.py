@@ -50,20 +50,58 @@ schedule through per-token ``step()`` dispatches (same admission cadence,
 same per-request keys, same sampler math), and both modes emit token
 streams bit-identical to each other and — for greedy requests — to a solo
 ``CausalLM.generate`` of the same prompt.
+
+Fault tolerance (the overload / fault / crash layer on top):
+
+* per-request DEADLINES — ``submit(..., ttft_deadline_ms=, deadline_ms=)``
+  converts wall budgets to the virtual block clock (``block_time_ms`` per
+  block); admission is earliest-deadline-first among arrived requests, a
+  queued or mid-prefill request whose deadline passed is expired (chunked
+  pages rolled back atomically through the cancel machinery) and a decoding
+  request past its completion deadline retires NOW with a partial,
+  ``expired=True`` completion;
+* BOUNDED admission queue — ``max_queue``/``shed_policy`` cap the arrived
+  backlog: the overflow victim gets a structured :class:`Rejected`
+  (retry-after estimate included) instead of queueing unboundedly, so
+  goodput under overload stays at capacity instead of collapsing into
+  universally-missed deadlines (Clipper's discipline);
+* deterministic FAULT INJECTION (``faults=FaultPlan(...)``, see
+  ``inference/faults.py``) — seeded ``PagePoolExhausted`` storms at the
+  allocator, transient insert/extend/decode dispatch failures absorbed by
+  retry+exponential backoff (escalating to :class:`DispatchFailed` past the
+  budget), and corrupted-page reads recovered by physically re-prefilling
+  the affected requests (streams stay bit-identical — the per-request rng
+  contract);
+* SNAPSHOT/RESTORE — ``snapshot()`` at any block boundary serializes the
+  scheduler + per-request state (prompt, generated tokens, rng base,
+  deadlines, chunk progress) to a JSON-able dict;
+  :meth:`ServeEngine.from_snapshot` re-admits every in-flight request by
+  replaying prompt+generated through the prefill path (radix prefix pages
+  are reused where they survive) and resumes each stream BIT-IDENTICAL from
+  the interruption point — token t of request r always draws from
+  ``fold_in(fold_in(base, r), t)``, so recovery is provable, not hopeful.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
+from neuronx_distributed_tpu.inference.faults import (
+    DispatchFailed,
+    FaultInjector,
+    FaultPlan,
+    TransientDispatchError,
+)
 from neuronx_distributed_tpu.inference.paged_cache import (
     ChunkedPrefill,
     PagePoolExhausted,
@@ -88,6 +126,10 @@ class Request:
     submit_block: int = 0           # block counter when submitted
     start_block: Optional[int] = None
     first_token_block: Optional[int] = None
+    # absolute virtual-time deadlines (None = none): first token must land
+    # by ttft_deadline_block, the whole stream by deadline_block
+    ttft_deadline_block: Optional[int] = None
+    deadline_block: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -102,6 +144,25 @@ class Completion:
     # surfaced it) — what the inter-token-latency report is computed from
     token_ts: Optional[np.ndarray] = None
     cancelled: bool = False
+    # deadline surface: ``expired`` = the ENGINE cut the request off when
+    # its deadline passed (tokens hold whatever was delivered by then);
+    # ``deadline_missed`` also covers requests that finished late
+    expired: bool = False
+    deadline_missed: bool = False
+
+
+@dataclasses.dataclass
+class Rejected:
+    """Load-shed verdict: the bounded admission queue refused this request
+    (``shed_policy`` picked it as the overflow victim). ``retry_after_blocks``
+    is the backlog-drain estimate — resubmitting after that many blocks has
+    a fresh admission chance; resubmission gets a NEW request id (and, by
+    the per-request rng contract, a fresh but deterministic stream)."""
+
+    request_id: int
+    retry_after_blocks: int
+    queue_depth: int
+    reason: str = "queue_full"
 
 
 @dataclasses.dataclass
@@ -153,6 +214,12 @@ class ServeEngine:
         pad_token_id: int = 0,
         rng: Optional[jax.Array] = None,
         prefill_chunk_tokens: int = 0,
+        max_queue: Optional[int] = None,
+        shed_policy: str = "tail",
+        block_time_ms: float = 1.0,
+        faults: Optional[Union[FaultPlan, FaultInjector]] = None,
+        dispatch_retries: int = 3,
+        dispatch_backoff_s: float = 0.001,
     ):
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
@@ -164,23 +231,57 @@ class ServeEngine:
                 f"prefill_chunk_tokens {prefill_chunk_tokens} exceeds the "
                 f"largest prefill bucket {lm.buckets[-1]} (each chunk must "
                 f"ride a compiled bucket)")
+        if shed_policy not in ("tail", "deadline"):
+            raise ValueError(
+                f"shed_policy must be 'tail' or 'deadline', got {shed_policy!r}")
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        if block_time_ms <= 0:
+            raise ValueError(f"block_time_ms must be > 0, got {block_time_ms}")
+        if dispatch_retries < 0:
+            raise ValueError(f"dispatch_retries must be >= 0, got {dispatch_retries}")
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
         self.prefill_chunk_tokens = int(prefill_chunk_tokens)
         self.slot_sampler = SlotSampler(top_k=top_k, top_p=top_p)
         self.pad_token_id = int(pad_token_id)
+        # overload / robustness knobs: deadlines are specified in ms and
+        # converted to the virtual block clock at block_time_ms per block
+        # (set it to the measured per-block wall time on real hardware; the
+        # default 1.0 makes ms == blocks, the deterministic test basis);
+        # max_queue bounds the ARRIVED backlog — overflow is shed per
+        # shed_policy ('tail' drops the newest arrival, 'deadline' drops the
+        # laxest deadline) with a structured Rejected verdict
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.shed_policy = shed_policy
+        self.block_time_ms = float(block_time_ms)
+        self.dispatch_retries = int(dispatch_retries)
+        self.dispatch_backoff_s = float(dispatch_backoff_s)
+        self._injector: Optional[FaultInjector] = None
+        if faults is not None:
+            self._injector = (faults if isinstance(faults, FaultInjector)
+                              else FaultInjector(faults))
         # base key: request r's token t draws from fold_in(fold_in(rng, r), t)
         self.rng = rng if rng is not None else jax.random.key(0)
         if lm._decode is None:
             lm.compile()
         self.session = lm.start_session()
+        if self._injector is not None and getattr(lm, "paged", False) \
+                and self.session.paged is not None:
+            # allocator seam: forced PagePoolExhausted storms
+            self.session.paged.allocator.fault_hook = self._injector.on_alloc
         b = lm.max_batch
         self.queue: deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * b
         self._out: Dict[int, List[int]] = {}
         self._out_ts: Dict[int, List[float]] = {}
         self.completed: List[Completion] = []
+        self.rejected: List[Rejected] = []
+        # in-flight recovery work: (request, generated-so-far, token stamps)
+        # awaiting a replay re-prefill (crash restore / corrupted-page
+        # recovery); drained before admission each block
+        self._replay_q: deque[Tuple[Request, List[int], List[float]]] = deque()
         # host mirrors of the on-device per-slot state (exact by design:
         # every device latch is a pure function of the fetched emissions)
         self._lengths = np.zeros((b,), np.int32)
@@ -207,18 +308,32 @@ class ServeEngine:
                       "inserted_requests": 0, "program_calls": 0,
                       "host_fetches": 0, "deferred_admissions": 0,
                       "chunk_program_calls": 0, "prefill_chunk_tokens_done": 0,
-                      "prefill_aborts": 0, "cancelled": 0}
+                      "prefill_aborts": 0, "cancelled": 0,
+                      "rejected": 0, "shed_evictions": 0, "expired": 0,
+                      "dispatch_retries": 0, "corrupt_page_replays": 0,
+                      "restored_requests": 0}
 
     # --- submission ------------------------------------------------------
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int,
                sampler: Optional[Sampler] = None,
                eos_token_id: Optional[int] = None,
-               arrival_block: int = 0) -> int:
-        """Queue a request; returns its id. The per-request ``sampler`` must
-        agree with the engine's static ``top_k``/``top_p`` (those are baked
-        into the compiled program — a mismatch would silently sample a
-        different distribution, so it is rejected here at admission)."""
+               arrival_block: int = 0,
+               ttft_deadline_ms: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Union[int, "Rejected"]:
+        """Queue a request; returns its id — or, when the bounded queue
+        sheds it at arrival, a structured :class:`Rejected` with a
+        retry-after estimate. The per-request ``sampler`` must agree with
+        the engine's static ``top_k``/``top_p`` (those are baked into the
+        compiled program — a mismatch would silently sample a different
+        distribution, so it is rejected here at admission).
+
+        ``ttft_deadline_ms``/``deadline_ms`` are budgets RELATIVE TO ARRIVAL
+        for the first token and the whole stream, converted to the virtual
+        block clock at ``block_time_ms`` per block. A queued or mid-prefill
+        request whose deadline passes is expired without burning prefill; a
+        decoding request past ``deadline_ms`` retires at the next block
+        boundary with a partial ``expired=True`` completion."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -261,8 +376,22 @@ class ServeEngine:
             temperature=0.0 if greedy else float(sampler.temperature),
             greedy=greedy, arrival_block=int(arrival_block),
             submit_block=self.blocks,
+            ttft_deadline_block=self._deadline_block(
+                arrival_block, ttft_deadline_ms, "ttft_deadline_ms"),
+            deadline_block=self._deadline_block(
+                arrival_block, deadline_ms, "deadline_ms"),
         )
         self._next_id += 1
+        # bound the ARRIVED backlog at submit time (the live-client path);
+        # future-arrival submissions are scheduled arrivals, not queue
+        # pressure — they are shed at the block boundary where they arrive
+        # into an already-full queue (_shed_overflow). Free slots extend the
+        # limit: a request the next round admits immediately is not backlog.
+        if self.max_queue is not None and req.arrival_block <= self.blocks:
+            arrived = sum(1 for r in self.queue
+                          if r.arrival_block <= self.blocks)
+            if arrived >= self.max_queue + len(self._free_slots()):
+                return self._shed(req)
         self.queue.append(req)
         return req.request_id
 
@@ -277,6 +406,17 @@ class ServeEngine:
                 del self.queue[i]
                 self.stats["cancelled"] += 1
                 return True
+        for i, (req, pregen, ts) in enumerate(self._replay_q):
+            if req.request_id == request_id:
+                del self._replay_q[i]
+                # the client already HAS pregen tokens; the completion
+                # records them so accounting stays whole-stream
+                self._out[req.request_id] = list(pregen)
+                self._out_ts[req.request_id] = list(ts)
+                self.completed.append(self._completion_of(
+                    req, cancelled=True))
+                self.stats["cancelled"] += 1
+                return True
         for slot, st in list(self._prefilling.items()):
             if st.req.request_id == request_id:
                 self._abort_prefill(slot, requeue=False)
@@ -285,21 +425,7 @@ class ServeEngine:
         for slot, req in enumerate(self.slots):
             if req is not None and req.request_id == request_id:
                 self.lm.retire(self.session, np.asarray([slot], np.int32))
-                ts = self._out_ts.pop(req.request_id, [])
-                self.completed.append(Completion(
-                    request_id=req.request_id,
-                    tokens=np.asarray(self._out.pop(req.request_id), np.int64),
-                    prompt_len=req.prompt.size,
-                    queue_blocks=max((req.start_block or 0) - req.arrival_block, 0),
-                    decode_blocks=self.blocks - (req.start_block or 0),
-                    ttft_blocks=max((req.first_token_block or self.blocks)
-                                    - req.arrival_block, 0),
-                    token_ts=np.asarray(ts, np.float64),
-                    cancelled=True,
-                ))
-                self.slots[slot] = None
-                self._active[slot] = False
-                self._done[slot] = False
+                self._complete_slot(slot, cancelled=True)
                 self.stats["cancelled"] += 1
                 return True
         return False
@@ -312,35 +438,259 @@ class ServeEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
+    # --- deadlines / shedding / dispatch (the fault-tolerance half) ------
+
+    def _deadline_block(self, arrival_block: int, ms: Optional[float],
+                        name: str) -> Optional[int]:
+        if ms is None:
+            return None
+        if ms <= 0:
+            raise ValueError(f"{name} must be > 0, got {ms}")
+        return int(arrival_block) + max(
+            1, int(np.ceil(float(ms) / self.block_time_ms)))
+
+    @staticmethod
+    def _admission_deadline(r: Request) -> float:
+        """EDF sort key: the binding deadline for getting ADMITTED — first
+        token (when set), else completion, else never."""
+        if r.ttft_deadline_block is not None:
+            return float(r.ttft_deadline_block)
+        if r.deadline_block is not None:
+            return float(r.deadline_block)
+        return float("inf")
+
+    @staticmethod
+    def _shed_key(r: Request):
+        """'deadline' shed policy victim ordering: laxest effective deadline
+        sheds first; deadline-free requests shed before any deadline'd one;
+        ties drop the newest submission."""
+        ttft = (float("inf") if r.ttft_deadline_block is None
+                else r.ttft_deadline_block)
+        full = float("inf") if r.deadline_block is None else r.deadline_block
+        return (min(ttft, full), r.request_id)
+
+    def _deadline_passed(self, r: Request) -> bool:
+        return ((r.ttft_deadline_block is not None
+                 and self.blocks > r.ttft_deadline_block)
+                or (r.deadline_block is not None
+                    and self.blocks > r.deadline_block))
+
+    def _missed(self, req: Request) -> bool:
+        if req.ttft_deadline_block is not None and (
+                req.first_token_block is None
+                or req.first_token_block > req.ttft_deadline_block):
+            return True
+        return (req.deadline_block is not None
+                and self.blocks > req.deadline_block)
+
+    def _retry_after(self) -> int:
+        """Backlog-drain estimate in blocks: total undelivered token budget
+        (queued + replaying + in-flight remainders) over the pool's K*slots
+        per-block service rate — what a shed client should wait before
+        resubmitting."""
+        queued = sum(r.max_new_tokens for r in self.queue)
+        queued += sum(r.max_new_tokens for r, _g, _t in self._replay_q)
+        inflight = sum(
+            req.max_new_tokens - len(self._out.get(req.request_id, []))
+            for req in self.slots if req is not None)
+        rate = max(self.lm.max_batch * self.block_steps, 1)
+        return max(1, -(-(queued + inflight) // rate))
+
+    def _shed(self, req: Request) -> Union[int, Rejected]:
+        """Shed on an over-full arrived backlog: 'tail' rejects the
+        newcomer; 'deadline' rejects whichever of queue+newcomer has the
+        laxest deadline (the newcomer may displace a queued request, which
+        then surfaces in ``self.rejected``)."""
+        victim = req
+        if self.shed_policy == "deadline":
+            arrived = [r for r in self.queue
+                       if r.arrival_block <= self.blocks]
+            worst = max(arrived + [req], key=self._shed_key)
+            if worst is not req:
+                self.queue.remove(worst)
+                self.queue.append(req)
+                victim = worst
+                self.stats["shed_evictions"] += 1
+        rej = Rejected(request_id=victim.request_id,
+                       retry_after_blocks=self._retry_after(),
+                       queue_depth=sum(1 for r in self.queue
+                                       if r.arrival_block <= self.blocks))
+        self.rejected.append(rej)
+        self.stats["rejected"] += 1
+        return rej if victim is req else req.request_id
+
+    def _shed_overflow(self) -> None:
+        """Block-boundary backlog bound: requests submitted with future
+        arrival blocks 'arrive' here — any overflow past ``max_queue`` is
+        shed by policy, exactly like a live submit into a full queue. Runs
+        AFTER the admission loop, so only requests that genuinely could not
+        be placed count as backlog (leftover free slots — pool-pressure
+        deferrals — extend the limit rather than shed waiting work)."""
+        if self.max_queue is None:
+            return
+        limit = self.max_queue + len(self._free_slots())
+        while True:
+            arrived = [r for r in self.queue
+                       if r.arrival_block <= self.blocks]
+            if len(arrived) <= limit:
+                return
+            if self.shed_policy == "deadline":
+                victim = max(arrived, key=self._shed_key)
+            else:
+                victim = max(arrived,
+                             key=lambda r: (r.arrival_block, r.request_id))
+            self.queue.remove(victim)
+            self.rejected.append(Rejected(
+                request_id=victim.request_id,
+                retry_after_blocks=self._retry_after(),
+                queue_depth=len(arrived) - 1))
+            self.stats["rejected"] += 1
+
+    def _dispatch(self, kind: str, fn):
+        """Run one compiled-program dispatch with transient-failure
+        retry+exponential backoff. The fault injector (when armed) raises
+        BEFORE ``fn`` executes, so a retried dispatch never re-runs device
+        work; past the retry budget the failure escalates to
+        :class:`DispatchFailed` (fail-stop — snapshot/restore recovers)."""
+        attempts = 0
+        while True:
+            try:
+                if self._injector is not None:
+                    self._injector.before_dispatch(kind)
+                return fn()
+            except TransientDispatchError as e:
+                attempts += 1
+                self.stats["dispatch_retries"] += 1
+                if attempts > self.dispatch_retries:
+                    raise DispatchFailed(
+                        f"{kind} dispatch failed {attempts} times "
+                        f"(retry budget {self.dispatch_retries})") from e
+                delay = self.dispatch_backoff_s * (2 ** (attempts - 1))
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _completion_of(self, req: Request, cancelled: bool = False,
+                       expired: bool = False) -> Completion:
+        ts = self._out_ts.pop(req.request_id, [])
+        return Completion(
+            request_id=req.request_id,
+            tokens=np.asarray(self._out.pop(req.request_id, []), np.int64),
+            prompt_len=req.prompt.size,
+            queue_blocks=max((req.start_block
+                              if req.start_block is not None else self.blocks)
+                             - req.arrival_block, 0),
+            decode_blocks=self.blocks - (req.start_block or 0),
+            ttft_blocks=max((req.first_token_block
+                             if req.first_token_block is not None
+                             else self.blocks) - req.arrival_block, 0),
+            token_ts=np.asarray(ts, np.float64),
+            cancelled=cancelled, expired=expired,
+            deadline_missed=expired or self._missed(req),
+        )
+
+    def _complete_slot(self, slot: int, cancelled: bool = False,
+                       expired: bool = False) -> None:
+        req = self.slots[slot]
+        self.completed.append(self._completion_of(req, cancelled=cancelled,
+                                                  expired=expired))
+        self.slots[slot] = None
+        self._active[slot] = False
+        self._done[slot] = False
+
+    def _expire_request(self, req: Request) -> None:
+        """Deadline passed before (or while) prefill: deliver an empty
+        ``expired`` completion — the client learns NOW instead of after
+        wasted prefill + decode."""
+        self._out.pop(req.request_id, None)
+        self._out_ts.pop(req.request_id, None)
+        self.completed.append(Completion(
+            request_id=req.request_id, tokens=np.zeros((0,), np.int64),
+            prompt_len=req.prompt.size,
+            queue_blocks=max(self.blocks - req.arrival_block, 0),
+            decode_blocks=0,
+            ttft_blocks=max(self.blocks - req.arrival_block, 0),
+            token_ts=np.zeros((0,), np.float64),
+            expired=True, deadline_missed=True,
+        ))
+        self.stats["expired"] += 1
+
+    def _expire_queued(self) -> None:
+        for r in [r for r in self.queue if self._deadline_passed(r)]:
+            self.queue.remove(r)
+            self._expire_request(r)
+
+    def _expire_prefilling(self) -> None:
+        """Mid-chunked-prefill expiry: the admission unwinds atomically
+        (pages released, device table reset — the cancel machinery) and the
+        request expires; spent chunk work is discarded."""
+        for slot, st in list(self._prefilling.items()):
+            if self._deadline_passed(st.req):
+                self._abort_prefill(slot, requeue=False)
+                self._expire_request(st.req)
+
+    def _expire_decoding(self) -> None:
+        """Completion-deadline expiry for live streams: retire NOW with the
+        tokens delivered so far (partial, ``expired=True``)."""
+        for slot, req in enumerate(self.slots):
+            if req is None or slot in self._prefilling or self._done[slot]:
+                continue
+            if (req.deadline_block is not None
+                    and self.blocks > req.deadline_block):
+                self.lm.retire(self.session, np.asarray([slot], np.int32))
+                self._complete_slot(slot, expired=True)
+                self.stats["expired"] += 1
+
     def _is_chunked(self, req: Request) -> bool:
         return bool(self.prefill_chunk_tokens
                     and req.prompt.size > self.prefill_chunk_tokens)
 
+    def _arrived_sorted(self) -> List[Request]:
+        """Arrived requests in admission order: earliest-deadline-first
+        (EDF — a request with a binding ttft/completion deadline jumps
+        ahead), deadline-free requests keep strict FIFO among themselves
+        (stable sort on queue position)."""
+        arrived = [(i, r) for i, r in enumerate(self.queue)
+                   if r.arrival_block <= self.blocks]
+        arrived.sort(key=lambda ir: (self._admission_deadline(ir[1]), ir[0]))
+        return [r for _, r in arrived]
+
     def _admit(self) -> None:
         """Admit arrived requests into free slots, batching prompts that
-        share a prefill bucket into ONE right-sized insert. Requests are
-        taken strictly in queue order (no starvation): the head request's
-        bucket defines the group, and the scan stops at the first queued
-        request with a different bucket, a later arrival, or a long prompt
-        (which takes the chunked path alone)."""
+        share a prefill bucket into ONE right-sized insert. Admission order
+        is deadline-aware (:meth:`_arrived_sorted`): the head request's
+        bucket defines the group, and the scan stops at the first request
+        with a different bucket or a long prompt (which takes the chunked
+        path alone). Expired queued requests leave first (no prefill burned
+        on a missed deadline); AFTER admission fills what it can, the
+        leftover arrived backlog is bounded (``max_queue`` shedding)."""
+        self._expire_queued()
+        try:
+            self._admit_loop()
+        finally:
+            self._shed_overflow()
+
+    def _admit_loop(self) -> None:
         while True:
             free = self._free_slots()
-            if not free or not self.queue:
+            if not free:
                 return
-            head = self.queue[0]
-            if head.arrival_block > self.blocks:
+            order = self._arrived_sorted()
+            if not order:
                 return
+            head = order[0]
             if self._is_chunked(head):
-                self.queue.popleft()
+                self.queue.remove(head)
                 self._begin_chunked(head, free[0])
                 continue
             bucket = self.lm._bucket_for(head.prompt.size)
             group: List[Request] = []
-            while (self.queue and len(group) < len(free)
-                   and self.queue[0].arrival_block <= self.blocks
-                   and not self._is_chunked(self.queue[0])
-                   and self.lm._bucket_for(self.queue[0].prompt.size) == bucket):
-                group.append(self.queue.popleft())
+            for r in order:
+                if (len(group) >= len(free) or self._is_chunked(r)
+                        or self.lm._bucket_for(r.prompt.size) != bucket):
+                    break
+                group.append(r)
+            for r in group:
+                self.queue.remove(r)
             try:
                 self._insert_group(group, free[: len(group)], bucket)
             except PagePoolExhausted:
@@ -373,10 +723,10 @@ class ServeEngine:
         # scratch — never a neighbour); the contiguous path ignores the kwarg
         reserve = np.asarray(
             [r.max_new_tokens + self.block_steps for r in group], np.int64)
-        logits = self.lm.insert(self.session, np.asarray(slot_ids, np.int32),
-                                ids, lengths=lens,
-                                pad_token_id=self.pad_token_id,
-                                reserve_tokens=reserve if self.paged else None)
+        logits = self._dispatch("insert", lambda: self.lm.insert(
+            self.session, np.asarray(slot_ids, np.int32), ids, lengths=lens,
+            pad_token_id=self.pad_token_id,
+            reserve_tokens=reserve if self.paged else None))
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
         # first token per inserted request: token index 0 of each request's
@@ -454,10 +804,10 @@ class ServeEngine:
                     return
                 tables = pkv.chunk_table(slot, st.chunk)[None]
             ids = req.prompt[st.written: st.written + n][None]
-            logits = self.lm.extend(
+            logits = self._dispatch("extend", lambda: self.lm.extend(
                 self.session, np.asarray([slot], np.int32), ids,
                 np.asarray([n], np.int32), np.asarray([st.written], np.int32),
-                tables=tables)
+                tables=tables))
             self.stats["chunk_program_calls"] += 1
             self.stats["prefill_chunk_tokens_done"] += n
             st.written += n
@@ -523,6 +873,282 @@ class ServeEngine:
             st.req.start_block = None
             self.queue.appendleft(st.req)
 
+    # --- recovery: replay re-prefill, corruption handling, snapshots -----
+    # A request's stream is a pure function of (prompt, params, base key,
+    # request id): token t draws from fold_in(fold_in(base, r), t). So ANY
+    # request whose KV is lost — process restart, corrupted page — can be
+    # re-prefilled from its host-side (prompt, generated) record and resume
+    # bit-identical at token index len(generated). That one invariant is the
+    # whole recovery story; everything below is bookkeeping around it.
+
+    def _drain_replays(self) -> None:
+        """Re-admit recovery work (restored / corruption-hit requests) into
+        free slots, ahead of fresh admissions — they represent streams the
+        client is already consuming. Pool pressure defers to the next block
+        (retirements return pages), same as normal admission."""
+        while self._replay_q:
+            free = self._free_slots()
+            if not free:
+                return
+            req, pregen, ts = self._replay_q[0]
+            try:
+                self._replay_admission(req, pregen, ts, free[0])
+            except PagePoolExhausted:
+                self.stats["deferred_admissions"] += 1
+                return
+            self._replay_q.popleft()
+
+    def _replay_admission(self, req: Request, pregen: List[int],
+                          ts: List[float], slot: int) -> None:
+        """Rebuild a request's KV from scratch and resume its stream at
+        token index ``len(pregen)``: prefill prompt+generated through
+        largest-bucket ``extend`` chunks (prefix-cache hits skip shared
+        pages where they survive), then sample token ``g`` under
+        ``fold_in(req_key, g)`` — bit-identical to the uninterrupted run."""
+        g = len(pregen)
+        seq = (np.concatenate([req.prompt, np.asarray(pregen, np.int32)])
+               if g else np.asarray(req.prompt, np.int32))
+        total = int(seq.size)
+        chunk_cap = self.lm.buckets[-1]
+        st = None
+        written = 0
+        pkv = self.session.paged if self.paged else None
+        if pkv is not None:
+            st = pkv.begin_chunked(
+                seq.tolist(),
+                total + (req.max_new_tokens - g) + self.block_steps)
+            written = st.start
+        logits = None
+        try:
+            while written < total:
+                n = min(chunk_cap, total - written)
+                final = written + n == total
+                tables = None
+                if pkv is not None:
+                    pkv.extend_chunked(st, written + n, final=final)
+                    tables = pkv.chunk_table(slot, st)[None]
+                ids = seq[written: written + n][None]
+                w = written
+                logits = self._dispatch("extend", lambda: self.lm.extend(
+                    self.session, np.asarray([slot], np.int32), ids,
+                    np.asarray([n], np.int32), np.asarray([w], np.int32),
+                    tables=tables))
+                written += n
+        except BaseException:
+            # atomic unwind: every page hold released, device table reset —
+            # the request stays in the replay queue for the next attempt
+            if pkv is not None:
+                pkv.abort_chunked(slot, st)
+                self.session.cache = _set_block_tables(self.session.cache,
+                                                       pkv.tables)
+            self.session.lengths[slot] = 0
+            self.session.active[slot] = False
+            raise
+        if pkv is not None:
+            pkv.finish_chunked(slot, st)
+        key = self._req_key(req.request_id)
+        sub = jax.vmap(jax.random.fold_in)(key[None],
+                                           jnp.full((1,), g, jnp.int32))
+        temps = np.asarray([req.temperature], np.float32)
+        greedy = np.asarray([req.greedy], bool)
+        tok = int(np.asarray(self.slot_sampler(
+            logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
+        now = time.perf_counter()
+        if req.start_block is None:
+            req.start_block = self.blocks
+        if req.first_token_block is None:
+            req.first_token_block = self.blocks
+        self.slots[slot] = req
+        self._out[req.request_id] = [int(t) for t in pregen]
+        self._out_ts[req.request_id] = list(ts[:g])
+        self._lengths[slot] = total
+        self.session.active[slot] = True
+        self._active[slot] = True
+        self._done[slot] = False
+        self._eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
+        self._temp[slot] = temps[0]
+        self._greedy[slot] = greedy[0]
+        self._tok[slot] = tok
+        self._slot_keys = self._slot_keys.at[slot].set(key)
+        self._gen_counts[slot] = g + 1
+        self._record(slot, tok, now)
+        self.stats["inserts"] += 1
+        self.stats["inserted_requests"] += 1
+
+    def _corrupt_page_bytes(self, pages: List[int]) -> None:
+        """Physically garble the K/V pool bytes of ``pages`` in every layer.
+        The injected fault is REAL — the recovery replay is thereby proven
+        to rewrite the data, not merely re-point block tables."""
+        def fix(path, leaf):
+            p = jax.tree_util.keystr(path)
+            if (p.endswith("['cached_key']")
+                    or p.endswith("['cached_value']")):
+                for pg in pages:
+                    leaf = leaf.at[:, pg].set(jnp.asarray(104729.0, leaf.dtype))
+            return leaf
+
+        self.session.cache = jax.tree_util.tree_map_with_path(
+            fix, self.session.cache)
+
+    def inject_page_corruption(self, pages: List[int]) -> None:
+        """Public corruption seam (ops drills / tests): declare ``pages``
+        corrupted between blocks — the engine garbles their bytes and runs
+        the full detect/invalidate/replay recovery."""
+        if not self.paged:
+            raise ValueError("page corruption applies to paged engines only")
+        self._handle_corrupt_pages([int(p) for p in pages])
+        self.stats.setdefault("injected_corruptions", 0)
+        self.stats["injected_corruptions"] += len(pages)
+
+    def _handle_corrupt_pages(self, pages: List[int]) -> None:
+        """Corrupted-page recovery, in dependency order: garble the bytes
+        (make the fault real), invalidate the pages from the prefix index
+        (no future sharer may splice them in), unwind any mid-prefill
+        admission holding one (it restarts from the queue), then re-prefill
+        every decoding request reading through one — their streams resume
+        bit-identical (per-request rng)."""
+        pkv = self.session.paged
+        bad = {int(p) for p in pages}
+        self._corrupt_page_bytes(sorted(bad))
+        if pkv.prefix is not None:
+            pkv.prefix.invalidate_pages(sorted(bad))
+        for slot, st in list(self._prefilling.items()):
+            held = set(st.chunk.shared + st.chunk.owned) if st.chunk else set()
+            if bad & held:
+                self._abort_prefill(slot, requeue=True)
+        for slot in range(self.lm.max_batch):
+            req = self.slots[slot]
+            if (req is None or slot in self._prefilling
+                    or not bad & set(pkv.slot_pages(slot))):
+                continue
+            pregen = list(self._out.get(req.request_id, []))
+            ts = list(self._out_ts.get(req.request_id, []))
+            self.lm.retire(self.session, np.asarray([slot], np.int32))
+            self.slots[slot] = None
+            self._active[slot] = False
+            self._done[slot] = False
+            self._replay_q.append((req, pregen, ts))
+            self.stats["corrupt_page_replays"] += 1
+        self._drain_replays()
+
+    # --- snapshot / restore ------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-serializable block-boundary state capture: scheduler config,
+        rng base, and every live request's (prompt, generated tokens,
+        deadlines, chunk progress). Completed requests are NOT included —
+        their streams were already delivered. Pair with
+        :meth:`from_snapshot`; take it between blocks (``run`` does, via
+        ``snapshot_path``)."""
+        def enc(r: Request, state: str, generated: List[int]) -> dict:
+            return {
+                "request_id": int(r.request_id),
+                "prompt": [int(t) for t in r.prompt],
+                "max_new_tokens": int(r.max_new_tokens),
+                "eos_token_id": (None if r.eos_token_id is None
+                                 else int(r.eos_token_id)),
+                "temperature": float(r.temperature),
+                "greedy": bool(r.greedy),
+                "arrival_block": int(r.arrival_block),
+                "ttft_deadline_block": r.ttft_deadline_block,
+                "deadline_block": r.deadline_block,
+                "generated": [int(t) for t in generated],
+                "state": state,
+            }
+
+        reqs = []
+        for slot, r in enumerate(self.slots):
+            if r is None:
+                continue
+            if slot in self._prefilling:
+                d = enc(r, "prefill", [])
+                # chunk progress is recorded for observability; the restore
+                # re-prefills from scratch (the pages died with the process)
+                d["prefill_written"] = int(self._prefilling[slot].written)
+                reqs.append(d)
+            else:
+                reqs.append(enc(r, "decoding", self._out[r.request_id]))
+        for req, pregen, _ts in self._replay_q:
+            reqs.append(enc(req, "decoding", pregen))
+        for r in self.queue:
+            reqs.append(enc(r, "queued", []))
+        return {
+            "version": 1,
+            "blocks": int(self.blocks),
+            "next_id": int(self._next_id),
+            "rng": np.asarray(jax.random.key_data(self.rng)).tolist(),
+            "config": {
+                "block_steps": self.block_steps,
+                "fused": self.fused,
+                "prefill_chunk_tokens": self.prefill_chunk_tokens,
+                "top_k": self.slot_sampler.top_k,
+                "top_p": self.slot_sampler.top_p,
+                "pad_token_id": self.pad_token_id,
+                "max_queue": self.max_queue,
+                "shed_policy": self.shed_policy,
+                "block_time_ms": self.block_time_ms,
+                "dispatch_retries": self.dispatch_retries,
+                "paged": self.paged,
+            },
+            "requests": reqs,
+        }
+
+    def save_snapshot(self, path: str) -> None:
+        """Crash-safe snapshot write (tmp + atomic rename): a reader never
+        sees a half-written file, so a crash DURING the snapshot leaves the
+        previous one intact."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def from_snapshot(cls, lm: CausalLM, snap: Union[dict, str],
+                      **overrides) -> "ServeEngine":
+        """Rebuild an engine from a :meth:`snapshot` (dict or file path) on
+        a fresh session: queued requests re-enter the queue with their
+        original ids and deadlines; in-flight requests replay
+        prompt+generated through the prefill path and resume BIT-IDENTICAL
+        at the interruption point. ``overrides`` patch scheduler knobs
+        (e.g. ``fused=False`` restores into the stepwise oracle — streams
+        are schedule-independent, so that is still exact)."""
+        if isinstance(snap, str):
+            with open(snap) as f:
+                snap = json.load(f)
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {snap.get('version')}")
+        cfg = dict(snap.get("config", {}))
+        cfg.pop("paged", None)   # informational: the lm decides the mode
+        cfg.update(overrides)
+        rng = jax.random.wrap_key_data(
+            jnp.asarray(snap["rng"], jnp.uint32))
+        eng = cls(lm, rng=rng, **cfg)
+        eng.blocks = int(snap["blocks"])
+        eng._next_id = int(snap["next_id"])
+        for rd in snap["requests"]:
+            req = Request(
+                request_id=int(rd["request_id"]),
+                prompt=np.asarray(rd["prompt"], np.int32),
+                max_new_tokens=int(rd["max_new_tokens"]),
+                eos_token_id=rd["eos_token_id"],
+                temperature=float(rd["temperature"]),
+                greedy=bool(rd["greedy"]),
+                arrival_block=int(rd["arrival_block"]),
+                submit_block=eng.blocks,
+                ttft_deadline_block=rd.get("ttft_deadline_block"),
+                deadline_block=rd.get("deadline_block"),
+            )
+            if rd["state"] == "decoding":
+                eng._replay_q.append(
+                    (req, [int(t) for t in rd["generated"]], []))
+            else:
+                # mid-prefill admissions restart from the queue (listed
+                # before queued entries, so they keep admission priority)
+                eng.queue.append(req)
+            eng.stats["restored_requests"] += 1
+        eng._drain_replays()
+        return eng
+
     def _record(self, slot: int, token: int, ts: float) -> None:
         """Append one emitted token to the slot's request; latch done on EOS
         or exhausted budget (the host half of the retire-on-EOS contract)."""
@@ -545,38 +1171,34 @@ class ServeEngine:
             return
         self.lm.retire(self.session, np.asarray(finished, np.int32))
         for slot in finished:
-            req = self.slots[slot]
-            ts = self._out_ts.pop(req.request_id, [])
-            self.completed.append(Completion(
-                request_id=req.request_id,
-                tokens=np.asarray(self._out.pop(req.request_id), np.int64),
-                prompt_len=req.prompt.size,
-                queue_blocks=max((req.start_block or 0) - req.arrival_block, 0),
-                decode_blocks=self.blocks - (req.start_block or 0),
-                ttft_blocks=max((req.first_token_block or 0)
-                                - req.arrival_block, 0),
-                token_ts=np.asarray(ts, np.float64),
-            ))
-            self.slots[slot] = None
-            self._active[slot] = False
+            self._complete_slot(slot)
 
     # --- the block loop --------------------------------------------------
 
     def step_block(self) -> bool:
-        """One scheduling round: admit, spend the prefill-chunk budget,
-        advance every active slot ``block_steps`` tokens, record emissions,
-        retire finished slots. Returns False when there is nothing left to
-        do at the current virtual time."""
+        """One scheduling round: drain recovery replays, admit (expire/shed
+        first), spend the prefill-chunk budget, advance every active slot
+        ``block_steps`` tokens, record emissions, expire past-deadline
+        streams, retire finished slots. Returns False when there is nothing
+        left to do at the current virtual time."""
+        self._drain_replays()     # recovery work re-enters ahead of admits
         self._admit()
         self._retire_finished()   # a 1-token budget finishes at insert time
         self._admit()             # ... freeing its slot for queued work now
+        self._expire_prefilling()  # deadline died mid-chunk: unwind, expire
         self._advance_prefill()   # <= prefill_chunk_tokens of pending prefill
         self._retire_finished()   # a 1-token budget may finish at chunk end
+        if self._injector is not None and self.paged:
+            victims = self._injector.pages_to_corrupt(
+                self.session.paged.live_pages())
+            if victims:
+                self._handle_corrupt_pages(victims)
         if not self._active.any():
-            if not self.queue and not self._prefilling:
+            if (not self.queue and not self._prefilling
+                    and not self._replay_q):
                 return False
-            # nothing decoding, but arrivals or chunked prefill pending:
-            # advance virtual time
+            # nothing decoding, but arrivals, chunked prefill, or deferred
+            # recovery replays pending: advance virtual time
             self.blocks += 1
             self.stats["blocks"] += 1
             return True
@@ -595,6 +1217,7 @@ class ServeEngine:
             self._gen_counts += 1
         self._tok = toks[-1].astype(np.int32)
         self.blocks += 1
+        self._expire_decoding()   # completion deadline passed: partial NOW
         self._retire_finished()
         return True
 
@@ -606,13 +1229,14 @@ class ServeEngine:
         if self.fused:
             fused = self.lm.compile_session_decode_fused(
                 self.block_steps, self.slot_sampler, self.pad_token_id)
-            toks, cache, _nxt, _len, _done = fused(
-                self.lm.params, self.session.cache,
-                jnp.asarray(self._tok[:, None]), self._slot_keys,
-                jnp.asarray(self._gen_counts),
-                jnp.asarray(self._lengths), jnp.asarray(self._active),
-                jnp.asarray(self._done), jnp.asarray(self._eos),
-                jnp.asarray(self._temp), jnp.asarray(self._greedy))
+            args = (self.lm.params, self.session.cache,
+                    jnp.asarray(self._tok[:, None]), self._slot_keys,
+                    jnp.asarray(self._gen_counts),
+                    jnp.asarray(self._lengths), jnp.asarray(self._active),
+                    jnp.asarray(self._done), jnp.asarray(self._eos),
+                    jnp.asarray(self._temp), jnp.asarray(self._greedy))
+            toks, cache, _nxt, _len, _done = self._dispatch(
+                "decode", lambda: fused(*args))
             self.session.cache = cache
             self.session.lengths = self.session.lengths + self.block_steps
             self.stats["program_calls"] += 1
@@ -634,9 +1258,10 @@ class ServeEngine:
             # (dropped) writes run out the block — the stepwise oracle must
             # replicate the device semantics exactly or the two modes would
             # diverge on requests admitted flush against max_seq_len
-            logits, cache = self.lm._decode(
-                self.lm.params, self.session.cache,
-                jnp.asarray(tok[:, None], jnp.int32))
+            logits, cache = self._dispatch(
+                "decode", lambda t=tok: self.lm._decode(
+                    self.lm.params, self.session.cache,
+                    jnp.asarray(t[:, None], jnp.int32)))
             self.session.cache = cache
             self.session.lengths += 1
             nxt = np.asarray(self.slot_sampler(logits[:, 0], sub, temp, greedy))
@@ -650,14 +1275,27 @@ class ServeEngine:
             tok = nxt.astype(np.int32)
         return out
 
-    def run(self, max_blocks: Optional[int] = None) -> List[Completion]:
+    def run(self, max_blocks: Optional[int] = None,
+            snapshot_path: Optional[str] = None,
+            snapshot_every_blocks: int = 8) -> List[Completion]:
         """Drive blocks until the queue and every slot drain (or
-        ``max_blocks`` elapse); returns completions in finish order."""
+        ``max_blocks`` elapse); returns completions in finish order.
+
+        ``snapshot_path`` arms crash recovery: the engine writes an atomic
+        :meth:`snapshot` every ``snapshot_every_blocks`` rounds and REMOVES
+        it on a clean drain — so the file existing at startup means the
+        previous run died mid-trace, and :meth:`from_snapshot` resumes its
+        in-flight streams bit-identical."""
+        every = max(int(snapshot_every_blocks), 1)
         n = 0
         while self.step_block():
             n += 1
+            if snapshot_path and n % every == 0:
+                self.save_snapshot(snapshot_path)
             if max_blocks is not None and n >= max_blocks:
-                break
+                return self.completed
+        if snapshot_path and os.path.exists(snapshot_path):
+            os.remove(snapshot_path)   # clean drain: nothing to recover
         return self.completed
 
 
@@ -668,6 +1306,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     shared_prefix_len: int = 0,
                     long_prompt_frac: float = 0.0,
                     long_prompt_len: int = 0,
+                    ttft_deadline_ms: Optional[float] = None,
+                    deadline_ms: Optional[float] = None,
                     seed: int = 0) -> List[dict]:
     """Deterministic synthetic arrival trace (virtual time in blocks):
     exponential inter-arrivals, prompt lengths cycled through
@@ -702,22 +1342,32 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
             "max_new_tokens": max_new_tokens,
             "eos_token_id": eos_token_id,
             "arrival_block": int(t),
+            # per-request SLO budgets (None = none): the overload bench
+            # attaches these to measure deadline-miss rate and goodput
+            "ttft_deadline_ms": ttft_deadline_ms,
+            "deadline_ms": deadline_ms,
         })
     return trace
 
 
 def run_trace(engine: ServeEngine, trace: List[dict],
-              max_blocks: Optional[int] = None) -> dict:
+              max_blocks: Optional[int] = None,
+              snapshot_path: Optional[str] = None) -> dict:
     """Submit a synthetic trace and drive the engine to completion; returns
     the serving report (throughput, latency-in-blocks percentiles, wall
-    TTFT/inter-token-latency surface, host-op accounting) used by
+    TTFT/inter-token-latency surface, host-op accounting, and — when the
+    trace carries deadlines or the engine bounds its queue — the overload
+    surface: rejected/expired counts, deadline-miss rate, goodput) used by
     ``runner.py serve`` and the bench."""
     for item in trace:
         engine.submit(item["prompt"], item["max_new_tokens"],
                       eos_token_id=item.get("eos_token_id"),
-                      arrival_block=item.get("arrival_block", 0))
+                      arrival_block=item.get("arrival_block", 0),
+                      ttft_deadline_ms=item.get("ttft_deadline_ms"),
+                      deadline_ms=item.get("deadline_ms"))
     t0 = time.perf_counter()
-    completions = engine.run(max_blocks=max_blocks)
+    completions = engine.run(max_blocks=max_blocks,
+                             snapshot_path=snapshot_path)
     wall_s = time.perf_counter() - t0
     total_tokens = int(sum(len(c.tokens) for c in completions))
     decode_blocks = max(engine.stats["decode_blocks"], 1)
@@ -786,6 +1436,36 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         if gaps_ms else None,
         "per_request": per_request,
     }
+    # overload / robustness surface: rejected-by-shedding, expired-by-
+    # deadline, miss rate over ALL submissions (shed counts as a miss — a
+    # rejected client got nothing, exactly like a blown deadline, just
+    # cheaply and immediately), and GOODPUT: only tokens of requests that
+    # completed within their deadlines count
+    submitted = len(trace)
+    rejected = len(engine.rejected)
+    expired = sum(1 for c in completions if c.expired)
+    missed = sum(1 for c in completions if c.deadline_missed)
+    has_deadlines = any(item.get("deadline_ms") or item.get("ttft_deadline_ms")
+                        for item in trace)
+    ontime_tokens = sum(
+        len(c.tokens) for c in completions
+        if not (c.deadline_missed or c.expired or c.cancelled))
+    report.update({
+        "rejected": rejected,
+        "expired": expired,
+        "shed_evictions": engine.stats["shed_evictions"],
+        "max_queue": engine.max_queue,
+        "shed_policy": engine.shed_policy,
+        "deadline_miss_rate": (round((rejected + missed) / submitted, 4)
+                               if has_deadlines and submitted else None),
+        "goodput_tokens_per_sec": (round(ontime_tokens / wall_s, 1)
+                                   if wall_s > 0 else None),
+        "dispatch_retries": engine.stats["dispatch_retries"],
+        "corrupt_page_replays": engine.stats["corrupt_page_replays"],
+        "restored_requests": engine.stats["restored_requests"],
+    })
+    if engine._injector is not None:
+        report["fault_stats"] = dict(engine._injector.stats)
     pkv = getattr(engine.session, "paged", None)
     if pkv is not None:
         kv = engine.lm.kv_cache_bytes()

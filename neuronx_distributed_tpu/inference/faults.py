@@ -1,0 +1,175 @@
+"""Deterministic fault injection for the serving engine (the chaos half of
+the fault-tolerance layer; Clipper's deadline/shedding discipline and
+CheckFreq-style cheap recovery are the production patterns PAPERS.md's
+serving rows point at).
+
+Three injection seams, matching the three failure classes a real serving
+deployment sees, each driven by a seeded :class:`FaultPlan` so a chaos run
+is REPLAYABLE — the same plan over the same trace makes the same decisions
+in the same order, so "replay the storm" is a one-line reproducer:
+
+* **allocator** (``PageAllocator.fault_hook``) — an alloc that would have
+  succeeded is forced to fail for ``pool_storm_len`` consecutive calls: a
+  :class:`~neuronx_distributed_tpu.inference.paged_cache.PagePoolExhausted`
+  storm. Exercises the scheduler's deferral / chunked-abort / atomic
+  rollback machinery under pressure the pool itself never produces.
+* **dispatch** (``FaultInjector.before_dispatch``) — a compiled-program
+  dispatch (insert / extend / decode) raises
+  :class:`TransientDispatchError` BEFORE the program runs (so no device
+  state mutated — the retry is trivially safe), for up to
+  ``dispatch_max_failures`` consecutive attempts. The engine retries with
+  exponential backoff and escalates to :class:`DispatchFailed` past its
+  retry budget.
+* **storage/pages** (``FaultInjector.pages_to_corrupt``) — per decode
+  block, a live KV page may be declared corrupted. The engine physically
+  garbles the page's pool bytes, invalidates it from the radix prefix
+  index, and re-prefills every affected request from its host-side
+  (prompt, generated) record — the per-request rng contract makes the
+  recovered stream bit-identical, which the chaos tests assert.
+
+Decisions are drawn from PER-SEAM ``RandomState`` streams (seed folded with
+the seam name), so adding draws at one seam never perturbs another — the
+property the replay-twice-identical test pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+
+class TransientDispatchError(RuntimeError):
+    """A compiled-program dispatch failed before running (injected or
+    driver-transient). Safe to retry: no device state was mutated."""
+
+
+class DispatchFailed(RuntimeError):
+    """A dispatch kept failing past the engine's retry budget — the
+    fail-stop escalation (snapshot/restore is the recovery path)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seeded chaos schedule. All probabilities are per-event; zero
+    disables a seam. ``pool_storm_len`` / ``dispatch_max_failures`` bound
+    how long one injected failure episode lasts — keep
+    ``dispatch_max_failures <= ServeEngine(dispatch_retries=...)`` for a
+    recoverable storm (larger values test the fail-stop escalation)."""
+
+    seed: int = 0
+    pool_exhaust_prob: float = 0.0
+    pool_storm_len: int = 1
+    dispatch_fail_prob: float = 0.0
+    dispatch_max_failures: int = 1
+    corrupt_page_prob: float = 0.0
+
+    def __post_init__(self):
+        for name in ("pool_exhaust_prob", "dispatch_fail_prob",
+                     "corrupt_page_prob"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
+            raise ValueError("storm lengths must be >= 1")
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Build from a JSON object string (the ``--fault_plan`` CLI
+        surface; the runner resolves file paths before calling this)."""
+        d = json.loads(spec)
+        if not isinstance(d, dict):
+            raise ValueError(f"fault plan must be a JSON object, got {d!r}")
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FaultInjector:
+    """Stateful executor of one :class:`FaultPlan`. One injector per engine
+    run — its per-seam streams and storm counters ARE the run's fault
+    schedule, so two engines must not share one."""
+
+    def __init__(self, plan: FaultPlan):
+        import numpy as np
+
+        self.plan = plan
+        # independent per-seam streams: the seam name is folded into the
+        # seed, so one seam's draw count never shifts another's schedule
+        self._rs = {
+            seam: np.random.RandomState(
+                (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
+            for seam in ("alloc", "dispatch", "corrupt")
+        }
+        self._storm_left = 0
+        self._fail_left: Dict[str, int] = {}
+        self.stats = {"alloc_faults": 0, "dispatch_faults": 0,
+                      "pages_corrupted": 0}
+
+    # --- allocator seam --------------------------------------------------
+
+    def on_alloc(self, n: int) -> bool:
+        """Called by ``PageAllocator.alloc`` when the request WOULD succeed;
+        True forces the exhausted path (the storm pretends the pool is
+        empty)."""
+        if self._storm_left > 0:
+            self._storm_left -= 1
+            self.stats["alloc_faults"] += 1
+            return True
+        p = self.plan.pool_exhaust_prob
+        if p and self._rs["alloc"].random_sample() < p:
+            self._storm_left = self.plan.pool_storm_len - 1
+            self.stats["alloc_faults"] += 1
+            return True
+        return False
+
+    # --- dispatch seam ---------------------------------------------------
+
+    def before_dispatch(self, kind: str) -> None:
+        """Raise :class:`TransientDispatchError` to fail the upcoming
+        ``kind`` dispatch (insert/extend/decode). Runs BEFORE the compiled
+        program, so an injected failure never leaves device state half
+        mutated."""
+        left = self._fail_left.get(kind, 0)
+        if left > 0:
+            self._fail_left[kind] = left - 1
+            self.stats["dispatch_faults"] += 1
+            raise TransientDispatchError(f"injected {kind} dispatch failure")
+        p = self.plan.dispatch_fail_prob
+        if p and self._rs["dispatch"].random_sample() < p:
+            self._fail_left[kind] = self.plan.dispatch_max_failures - 1
+            self.stats["dispatch_faults"] += 1
+            raise TransientDispatchError(f"injected {kind} dispatch failure")
+
+    # --- corruption seam -------------------------------------------------
+
+    def pages_to_corrupt(self, live_pages: Sequence[int]) -> List[int]:
+        """Per decode block: pick at most one live page to corrupt (empty
+        list = no fault this block). The engine garbles the page's bytes and
+        runs the detect/invalidate/replay recovery."""
+        p = self.plan.corrupt_page_prob
+        if not p or not len(live_pages):
+            return []
+        rs = self._rs["corrupt"]
+        if rs.random_sample() < p:
+            page = int(sorted(int(x) for x in live_pages)[
+                rs.randint(len(live_pages))])
+            self.stats["pages_corrupted"] += 1
+            return [page]
+        return []
+
+
+def resolve_fault_plan(
+        spec: Optional[str]) -> Optional[FaultPlan]:
+    """CLI helper: ``spec`` is None (no faults), a path to a JSON file, or
+    an inline JSON object string."""
+    if not spec:
+        return None
+    import os
+
+    if os.path.exists(spec):
+        with open(spec) as f:
+            spec = f.read()
+    return FaultPlan.from_spec(spec)

@@ -63,6 +63,10 @@ class PageAllocator:
         self.reserved = int(reserved)
         self._free = deque(range(reserved, num_pages))
         self.refcount = np.zeros((num_pages,), np.int32)
+        # fault-injection seam (inference/faults.py): when set, an alloc
+        # that WOULD succeed may be forced down the exhausted path —
+        # deterministic PagePoolExhausted storms for the chaos tests
+        self.fault_hook = None
 
     def available(self) -> int:
         return len(self._free)
@@ -73,6 +77,8 @@ class PageAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh pages at refcount 1, or None when the pool can't cover."""
         if n > len(self._free):
+            return None
+        if self.fault_hook is not None and self.fault_hook(n):
             return None
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
@@ -173,6 +179,35 @@ class RadixPrefixIndex:
             self.cached_pages -= 1
             freed += len(self.allocator.release([victim.page]))
         return freed
+
+    def invalidate_pages(self, pages: Sequence[int]) -> int:
+        """Drop every trie entry whose physical page is in ``pages`` (a
+        corrupted-page report), INCLUDING its whole subtree — a descendant's
+        prefix runs through the bad page, so a sharer admitted against it
+        would splice corrupted K/V into its context. Each removed node's
+        cache hold is released. Returns the number of entries removed."""
+        bad = {int(p) for p in pages}
+        removed = 0
+
+        def scrub(node):
+            nonlocal removed
+            for key, child in list(node.children.items()):
+                if child.page in bad:
+                    removed += self._drop_subtree(child)
+                    del node.children[key]
+                else:
+                    scrub(child)
+
+        scrub(self.root)
+        return removed
+
+    def _drop_subtree(self, node) -> int:
+        n = 1
+        self.cached_pages -= 1
+        self.allocator.release([node.page])
+        for child in node.children.values():
+            n += self._drop_subtree(child)
+        return n
 
     def _iter_nodes(self):
         stack = list(self.root.children.values())
@@ -418,6 +453,20 @@ class PagedKVCache:
         self.allocator.release(state.owned)
         state.shared, state.owned = [], []
         self.tables[slot] = self.scratch[slot]
+
+    # --- introspection ---------------------------------------------------
+
+    def live_pages(self) -> List[int]:
+        """Sorted physical ids of every page a LIVE slot currently holds —
+        the victim pool for corruption injection (a corrupted slot-held page
+        forces a request replay; cache-only pages are merely invalidated)."""
+        pages = set()
+        for held in self._slot_pages.values():
+            pages.update(int(p) for p in held)
+        return sorted(pages)
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages.get(slot, []))
 
     # --- sizing ----------------------------------------------------------
 

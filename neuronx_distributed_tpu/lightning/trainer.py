@@ -10,6 +10,8 @@ forked per device).
 
 from __future__ import annotations
 
+import signal
+import threading
 from typing import Any, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -43,6 +45,7 @@ class NxDTrainer:
         val_steps: int = 1,
         checkpoint_dir: Optional[str] = None,
         seed: int = 0,
+        handle_preemption: bool = True,
     ):
         self.max_steps = int(max_steps)
         self.callbacks = list(callbacks)
@@ -55,6 +58,12 @@ class NxDTrainer:
         self.optimizer = None
         self.state = None
         self.train_stream = None   # restorable data stream, set by fit()
+        # preemption (SIGTERM from the cluster scheduler / SIGINT): the
+        # handler only sets a flag; fit() checkpoints at the NEXT step
+        # boundary — a mid-step save would snapshot donated buffers the
+        # running program is overwriting
+        self.handle_preemption = bool(handle_preemption)
+        self.preempted = False
 
     # --- loop ------------------------------------------------------------
 
@@ -98,6 +107,26 @@ class NxDTrainer:
             cb.on_train_start(self, module)
         metrics: Dict[str, Any] = {}
         start = int(self.state.step)
+        # arm the preemption hook for the duration of the loop (main thread
+        # only — signal.signal raises elsewhere); original handlers restored
+        # on exit so nested/later fits and the surrounding process keep
+        # their semantics (SIGINT's KeyboardInterrupt included)
+        self.preempted = False
+        installed: Dict[int, Any] = {}
+
+        def _on_signal(signum, frame):
+            self.preempted = True
+            logger.warning(
+                "signal %d received: checkpointing and stopping at the next "
+                "step boundary", signum)
+
+        if (self.handle_preemption
+                and threading.current_thread() is threading.main_thread()):
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    installed[sig] = signal.signal(sig, _on_signal)
+                except (ValueError, OSError):  # non-main interpreter quirks
+                    pass
         # Batch alignment: step i+1 trains the stream's i-th batch. The init
         # sample IS batch 0 (re-queued on fresh runs); a resumed run must
         # move the stream forward so global step <-> batch pairing matches a
@@ -115,32 +144,64 @@ class NxDTrainer:
         else:
             for _ in range(max(start - 1, 0)):
                 next(stream_it)
-        for i in range(start, self.max_steps):
-            batch = pending if pending is not None else next(stream_it)
-            pending = None
-            with step_annotation(i):
-                self.state, metrics = step_fn(self.state, batch,
-                                              jax.random.key(self.seed + i + 1))
-            step = i + 1
-            if self.logger is not None:
-                self.logger.log_metrics(metrics, step)
-            for cb in self.callbacks:
-                cb.on_step_end(self, module, step, metrics)
-            if val_fn is not None and self.val_every_n_steps and (
-                step % self.val_every_n_steps == 0 or step == self.max_steps
-            ):
-                losses = [
-                    float(val_fn(self.state.params, next(val_batches),
-                                 jax.random.key(step)))
-                    for _ in range(self.val_steps)
-                ]
-                val_metrics = {"val_loss": float(np.mean(losses))}
+        try:
+            for i in range(start, self.max_steps):
+                batch = pending if pending is not None else next(stream_it)
+                pending = None
+                with step_annotation(i):
+                    self.state, metrics = step_fn(
+                        self.state, batch, jax.random.key(self.seed + i + 1))
+                step = i + 1
                 if self.logger is not None:
-                    self.logger.log_metrics(val_metrics, step)
+                    self.logger.log_metrics(metrics, step)
                 for cb in self.callbacks:
-                    cb.on_validation_end(self, module, step, val_metrics)
+                    cb.on_step_end(self, module, step, metrics)
+                if self.preempted:
+                    # step boundary: params/opt state are settled and the
+                    # stream position is exactly "step batches served", so
+                    # the final checkpoint resumes == a straight run
+                    # (ROADMAP #7's (epoch, cursor) stream state rides it)
+                    self._save_preemption_checkpoint(step)
+                    break
+                if val_fn is not None and self.val_every_n_steps and (
+                    step % self.val_every_n_steps == 0 or step == self.max_steps
+                ):
+                    losses = [
+                        float(val_fn(self.state.params, next(val_batches),
+                                     jax.random.key(step)))
+                        for _ in range(self.val_steps)
+                    ]
+                    val_metrics = {"val_loss": float(np.mean(losses))}
+                    if self.logger is not None:
+                        self.logger.log_metrics(val_metrics, step)
+                    for cb in self.callbacks:
+                        cb.on_validation_end(self, module, step, val_metrics)
+        finally:
+            for sig, handler in installed.items():
+                signal.signal(sig, handler)
         for cb in self.callbacks:
             cb.on_train_end(self, module)
         if self.logger is not None:
             self.logger.finalize()
         return self.state, metrics
+
+    def _save_preemption_checkpoint(self, step: int) -> None:
+        """Final checkpoint on preemption: synchronous (the process is
+        about to die — an async tail would race the kill) and flushed, with
+        the data-stream position in user_content so the restarted job
+        resumes bit-identical to a straight run."""
+        if not self.checkpoint_dir:
+            logger.warning("preempted with no checkpoint_dir: stopping "
+                           "without a final checkpoint")
+            return
+        from neuronx_distributed_tpu.checkpoint import (
+            finalize_checkpoint, save_checkpoint,
+        )
+
+        content: Dict[str, Any] = {"step": step, "preempted": True}
+        if self.train_stream is not None:
+            content["data_state"] = self.train_stream.state_dict()
+        save_checkpoint(self.checkpoint_dir, f"step_{step}", self.state,
+                        user_content=content, async_save=False)
+        finalize_checkpoint()
+        logger.warning("preemption checkpoint saved at step %d", step)

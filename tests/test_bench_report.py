@@ -57,7 +57,13 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_itl_p99_ms": 9.8,
                                       "serve_itl_p99_ms_unchunked": 61.0,
                                       "serve_decode_stall_ms_longprompt": 58.0,
-                                      "serve_decode_stall_ms_longprompt_chunked": 9.5})
+                                      "serve_decode_stall_ms_longprompt_chunked": 9.5,
+                                      "serve_goodput_1x": 540.0,
+                                      "serve_goodput_2x_overload": 512.0,
+                                      "serve_goodput_2x_vs_1x": 0.948,
+                                      "serve_deadline_miss_rate_shed": 0.41,
+                                      "serve_deadline_miss_rate_noshed": 0.72,
+                                      "serve_recovery_replay_ms": 118.0})
     import neuronx_distributed_tpu.utils.cp_microbench as cpm
     monkeypatch.setattr(cpm, "measure_cp_ratio_isolated", lambda *a, **kw: {
         "cp_vs_sp_throughput": 0.97, "cp_vs_sp_throughput_ici_serial": 0.95,
@@ -112,6 +118,14 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
     assert h["serve_decode_stall_ms_longprompt_chunked"] < \
         h["serve_decode_stall_ms_longprompt"]
+    # overload + recovery keys (ISSUE 5): shedding must beat the unbounded
+    # queue on deadline-miss rate at 2x overload, goodput must hold within
+    # 10% of 1x load, and the crash-recovery replay cost rides the headline
+    assert d["serve_goodput_2x_overload"] == h["serve_goodput_2x_overload"]
+    assert h["serve_deadline_miss_rate_shed"] < \
+        h["serve_deadline_miss_rate_noshed"]
+    assert h["serve_goodput_2x_vs_1x"] >= 0.9
+    assert h["serve_recovery_replay_ms"] == 118.0
     # machine-state record (ISSUE 3 satellite): jax/jaxlib versions + XLA
     # flags land in the SIDECAR for cross-run comparability checks — and
     # stay out of the size-capped headline

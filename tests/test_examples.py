@@ -199,6 +199,66 @@ def test_inference_runner_serve_chunked_tiny(capsys):
     assert report["itl_p99_ms"] is not None
 
 
+def test_inference_runner_serve_robustness_tiny(capsys):
+    """ISSUE 5 CI gate: runner.py serve with deadlines, a bounded queue,
+    and a seeded fault plan — the report grows the overload/robustness
+    surface (miss rate, goodput, rejection/expiry accounting, fault
+    stats) and the engine still completes the trace."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3",
+                 "--deadline_ms", "40", "--max_queue", "3",
+                 "--shed_policy", "deadline",
+                 "--fault_plan", '{"seed": 2, "dispatch_fail_prob": 0.15}'])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] + report["rejected"] == 4
+    assert report["max_queue"] == 3 and report["shed_policy"] == "deadline"
+    assert report["deadline_miss_rate"] is not None
+    assert report["goodput_tokens_per_sec"] is not None
+    assert "fault_stats" in report
+
+
+def test_inference_runner_serve_snapshot_crash_recovery(capsys, tmp_path):
+    """ISSUE 5 CI gate, crash-recovery CLI contract: a run capped below
+    drain leaves a snapshot file; re-invoking serve with the same
+    --snapshot_path detects it, restores the in-flight streams, and
+    finishes them (then removes the file)."""
+    import argparse
+    import os
+
+    import jax
+    import runner
+
+    snap = str(tmp_path / "serve.snap")
+    # build the same tiny engine the CLI would, but stop mid-trace so the
+    # snapshot file survives (the CLI's run-to-drain would remove it)
+    from neuronx_distributed_tpu.inference import ServeEngine
+    from neuronx_distributed_tpu.inference.engine import synthetic_trace
+
+    lm, cfg = runner.build_model(argparse.Namespace(
+        tiny=True, model="llama", hf_checkpoint=None, max_seq_len=4096,
+        max_batch=2, tensor_parallel_size=None, quantize=False, paged=False,
+        cmd="serve"))
+    lm.compile()
+    eng = ServeEngine(lm, block_steps=3, rng=jax.random.key(0))
+    trace = synthetic_trace(3, cfg.vocab_size, prompt_lens=(8,),
+                            max_new_tokens=9, seed=0)
+    for item in trace:
+        eng.submit(item["prompt"], item["max_new_tokens"])
+    eng.run(max_blocks=1, snapshot_path=snap, snapshot_every_blocks=1)
+    assert os.path.exists(snap)
+    pre = {c.request_id: len(c.tokens) for c in eng.completed}
+    runner.main(["serve", "--tiny", "--max_batch", "2",
+                 "--snapshot_path", snap, "--fused_steps", "3"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["recovered"] is True
+    assert report["restored_requests"] >= 1
+    assert not os.path.exists(snap)
+    # every stream finished: pre-crash + recovered tokens == 3 x 9
+    assert sum(pre.values()) + report["total_generated_tokens"] == 3 * 9
+
+
 @pytest.mark.slow  # arrival-trace throughput comparison; tier-1 keeps the
 # fast smokes above
 def test_inference_runner_serve_chunked_matches_oneshot(capsys):

@@ -146,6 +146,53 @@ def test_resume_restores_data_stream_state(tmp_path):
     jax.tree.map(np.testing.assert_array_equal, straight, resumed)
 
 
+def test_preemption_signal_checkpoints_at_step_boundary_and_resumes(tmp_path):
+    """ISSUE 5 satellite: SIGTERM mid-fit sets a flag; the trainer saves a
+    final checkpoint at the NEXT step boundary and stops. A restarted fit
+    resumes from it and lands bit-identical to a straight run (the (epoch,
+    cursor) stream-state discipline of ROADMAP #7 rides the preemption
+    checkpoint too)."""
+    import os
+    import signal as _signal
+
+    from neuronx_distributed_tpu.checkpoint import latest_tag
+    from neuronx_distributed_tpu.lightning.callbacks import Callback
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ck = str(tmp_path / "ck")
+
+    class KillAtStep(Callback):
+        def __init__(self, step):
+            self.step = step
+
+        def on_step_end(self, trainer, module, step, metrics):
+            if step == self.step:
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+    def run(max_steps, kill_at=None):
+        cbs = [KillAtStep(kill_at)] if kill_at else []
+        trainer = NxDTrainer(max_steps=max_steps, checkpoint_dir=ck,
+                             callbacks=cbs)
+        state, _ = trainer.fit(TinyLlamaModule(), _batches())
+        return trainer, jax.tree.map(np.asarray, state.params)
+
+    straight_trainer, straight = run(4)
+    assert not straight_trainer.preempted
+    ps.destroy_model_parallel()
+    # SIGTERM delivered during step 2's callbacks: the flag is set, the
+    # loop checkpoints step_2 and stops — steps 3..4 never run
+    pre_trainer, _ = run(4, kill_at=2)
+    assert pre_trainer.preempted
+    assert int(pre_trainer.state.step) == 2
+    assert latest_tag(ck) == "step_2"
+    # the original SIGTERM disposition was restored after fit
+    assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
+    ps.destroy_model_parallel()
+    resumed_trainer, resumed = run(4)
+    assert int(resumed_trainer.state.step) == 4
+    jax.tree.map(np.testing.assert_array_equal, straight, resumed)
+
+
 def test_resume_batch_alignment(tmp_path):
     """Resumed fit must train the SAME batches at the same global steps as a
     straight run (r2 review: the init-consumed batch must not shift the

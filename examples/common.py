@@ -84,6 +84,14 @@ def add_common_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--metrics_file", type=str, default=None)
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="jax.profiler XProf trace output dir")
+    parser.add_argument("--trace_out", type=str, default=None,
+                        help="write a Chrome/Perfetto trace-event JSON of "
+                             "the host-side step timeline (step spans, "
+                             "checkpoint saves) to this path")
+    parser.add_argument("--metrics_out", type=str, default=None,
+                        help="write the run's metrics registry here "
+                             "(Prometheus text exposition; .json suffix "
+                             "writes the JSON snapshot instead)")
     parser.add_argument(
         "--tiny", action="store_true",
         help="shrink the model/batch to CI scale (virtual CPU mesh smoke)",
@@ -218,14 +226,44 @@ def train_loop(
     profile_dir: Optional[str] = None,
     seed: int = 0,
     extra_metrics: Optional[Dict[str, Any]] = None,
+    trace_out: Optional[str] = None,
+    metrics_out: Optional[str] = None,
 ):
     """Run ``steps`` training steps with throughput logging, optional
     periodic checkpointing, and optional XProf profiling. Returns
     ``(final_state, last_metrics_dict)``. ``extra_metrics``: static
-    key/values (e.g. data-loader stats) attached to every metrics line."""
+    key/values (e.g. data-loader stats) attached to every metrics line.
+
+    ``trace_out``/``metrics_out`` arm the host-side observability layer
+    (``neuronx_distributed_tpu.observability``): the trainer lane carries
+    one span per step (the dispatch+sync wall time) and per checkpoint
+    save, exported as Perfetto-loadable Chrome trace JSON; the registry
+    records the step-time histogram, tokens/s gauge and checkpoint
+    durations, exported as Prometheus text (or a JSON snapshot for a
+    ``.json`` path). Both default off — the step loop then pays one boolean
+    check per step."""
+    from neuronx_distributed_tpu.observability import MetricsRegistry, Tracer
+
     start_step = int(state.step)
     throughput = Throughput(batch_size)
     writer = MetricsWriter(metrics_file)
+    tracer = Tracer(enabled=bool(trace_out))
+    registry = MetricsRegistry()
+    m_step = registry.histogram("train_step_ms",
+                                help="per-step dispatch+sync wall ms")
+    m_ckpt = registry.histogram("train_checkpoint_ms",
+                                help="checkpoint save-call wall ms")
+    m_tok = registry.gauge("train_tokens_per_sec",
+                           help="tokens/s over the logging window")
+    m_steps = registry.counter("train_steps", help="optimizer steps run")
+
+    def timed_save(tag_step: int, **kw) -> None:
+        t0 = time.perf_counter()
+        with tracer.span(f"checkpoint_{tag_step}", ("trainer", "checkpoint")):
+            save_checkpoint(checkpoint_dir, f"step_{tag_step}", state,
+                            user_content={"step": tag_step}, num_kept=3, **kw)
+        m_ckpt.observe((time.perf_counter() - t0) * 1e3)
+
     metrics = {}
     last_logged = start_step
     # Multi-host: each process's iterator yields its LOCAL rows; assemble the
@@ -242,28 +280,44 @@ def train_loop(
         with profile_steps(profile_dir):
             for i in range(start_step, steps):
                 batch = shard_host_batch(next(batches))
+                t0 = time.perf_counter()
                 with step_annotation(i):
                     state, metrics = step_fn(state, batch, jax.random.key(seed + i + 1))
+                t1 = time.perf_counter()
+                # host wall per loop iteration: dispatch plus whatever
+                # backpressure sync the runtime imposes (steady-state this
+                # converges to true step time; the synced number is the
+                # throughput window below)
+                m_step.observe((t1 - t0) * 1e3)
+                m_steps.inc()
+                if tracer.enabled:
+                    tracer.complete(f"step_{i}", ("trainer", "steps"), t0, t1,
+                                    args={"step": i + 1})
                 if log_every and ((i + 1) % log_every == 0 or i + 1 == steps):
                     loss = float(metrics["loss"])  # host fetch = step synced
                     # get_throughput()'s time delta spans the steps since the
                     # previous log call — scale by exactly that count
                     seq_s = throughput.get_throughput() * (i + 1 - last_logged)
                     last_logged = i + 1
+                    seq_len = next(
+                        (v.shape[1] for v in batch.values()
+                         if getattr(v, "ndim", 0) >= 2), 1)
+                    m_tok.set(round(seq_s * seq_len, 1))
                     logger.info("step %d/%d loss %.4f (%.2f seq/s)", i + 1, steps, loss, seq_s)
                     writer.log(i + 1, loss=loss, seqs_per_sec=seq_s,
                                grad_norm=metrics.get("grad_norm", 0.0),
                                **(extra_metrics or {}))
                 if checkpoint_dir and checkpoint_every and (i + 1) % checkpoint_every == 0:
-                    save_checkpoint(checkpoint_dir, f"step_{i + 1}", state,
-                                    user_content={"step": i + 1}, async_save=True,
-                                    num_kept=3)
+                    timed_save(i + 1, async_save=True)
         if checkpoint_dir:
-            save_checkpoint(checkpoint_dir, f"step_{steps}", state,
-                            user_content={"step": steps}, num_kept=3)
+            timed_save(steps)
     finally:
         finalize_checkpoint()
         writer.close()
+        if trace_out:
+            tracer.export_chrome(trace_out)
+        if metrics_out:
+            registry.dump(metrics_out)
     return state, metrics
 
 

@@ -404,13 +404,24 @@ def cmd_serve(args) -> None:
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
                   prefill_chunk_tokens=args.prefill_chunk_tokens,
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
-                  block_time_ms=args.block_time_ms)
+                  block_time_ms=args.block_time_ms,
+                  trace=bool(args.trace_out))
+
+    def export_observability(engine) -> None:
+        # written AFTER the run so the trace covers the whole timeline; the
+        # trace file is Perfetto-loadable Chrome trace-event JSON, the
+        # metrics file Prometheus text (or a JSON snapshot for .json paths)
+        if args.trace_out:
+            engine.tracer.export_chrome(args.trace_out)
+        if args.metrics_out:
+            engine.metrics.dump(args.metrics_out)
     # crash recovery: a snapshot file surviving at startup means the
     # previous serve died mid-trace — restore it and finish those streams
     # (bit-identical from the interruption point) instead of starting over
     if args.snapshot_path and os.path.exists(args.snapshot_path):
         engine = ServeEngine.from_snapshot(lm, args.snapshot_path, **eng_kw)
         completions = engine.run()
+        export_observability(engine)
         os.remove(args.snapshot_path)
         print(json.dumps({
             "recovered": True,
@@ -451,6 +462,7 @@ def cmd_serve(args) -> None:
         warm.submit(item["prompt"], 2)
     warm.run()
     report = run_trace(engine, trace, snapshot_path=args.snapshot_path)
+    export_observability(engine)
     report.update({
         "model": args.model + ("_tiny" if args.tiny else ""),
         "max_batch": lm.max_batch,
@@ -654,6 +666,16 @@ def main(argv=None) -> None:
                             "drain; if it EXISTS at startup the previous "
                             "run's in-flight streams are restored and "
                             "finished bit-identical")
+        p.add_argument("--trace_out", type=str, default=None,
+                       help="serve: write the engine's per-request timeline "
+                            "(Chrome trace-event JSON, loadable in "
+                            "Perfetto) to this path after the run; also "
+                            "turns structured tracing on")
+        p.add_argument("--metrics_out", type=str, default=None,
+                       help="serve: write the engine's metrics registry "
+                            "(Prometheus text exposition; a .json path "
+                            "writes the JSON snapshot) to this path after "
+                            "the run")
         p.add_argument("--fault_plan", type=str, default=None,
                        help="serve: seeded chaos plan (JSON object or path "
                             "to one): pool_exhaust_prob/pool_storm_len/"

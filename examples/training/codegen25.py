@@ -171,6 +171,7 @@ def main(argv=None) -> float:
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         metrics_file=args.metrics_file, profile_dir=args.profile_dir,
         seed=args.seed,
+        trace_out=args.trace_out, metrics_out=args.metrics_out,
         extra_metrics={"loader_native": int(ds.using_native),
                        "loader_seq_len": int(ds.seq_len),
                        "loader_shards": len(paths)},

@@ -98,6 +98,7 @@ def main(argv=None) -> float:
         batch_size=batch, log_every=args.log_every,
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         metrics_file=args.metrics_file, profile_dir=args.profile_dir, seed=args.seed,
+        trace_out=args.trace_out, metrics_out=args.metrics_out,
     )
     return float(metrics["loss"])
 

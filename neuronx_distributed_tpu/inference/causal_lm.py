@@ -19,7 +19,8 @@ reference becomes plain slot indexing.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -196,8 +197,31 @@ class CausalLM:
         self._insert_scatter = {}   # rows -> donated row-scatter program
         self._paged_insert = {}     # (rows, bucket) -> donated paged insert
         self._chunk_extend = {}     # (rows, bucket) -> donated chunk-prefill extend
+        # observability: wall time of every AOT lower+compile, keyed by a
+        # stable program signature ("session_fused_k8", "insert_r2_b128",
+        # ...) — the compile half of the compile-vs-execute split (dispatch
+        # latency histograms are the execute half, inference/engine.py).
+        # Always recorded (one float per program, once); when a serving
+        # engine attaches its tracer, each compile also lands as a span on
+        # the engine "compile" lane.
+        self.compile_ms: Dict[str, float] = {}
+        self.tracer = None
 
     # --- compilation (reference ModelBuilder.trace over CTX/TKG) ---------
+
+    def _time_compile(self, signature: str, build):
+        """Run one AOT ``lower().compile()`` under a wall timer, recording
+        it per program signature. The timer is OUTSIDE the traced program —
+        tracing can never perturb what XLA compiles (the signature-identity
+        test pins this)."""
+        t0 = time.perf_counter()
+        prog = build()
+        t1 = time.perf_counter()
+        self.compile_ms[signature] = round((t1 - t0) * 1e3, 2)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.complete("compile:" + signature, ("engine", "compile"), t0, t1)
+        return prog
 
     def _resolve(self, params):
         """The single place the serving param transform applies (e.g. int8
@@ -230,16 +254,19 @@ class CausalLM:
             # the pool-donating insert programs, compiled lazily per width
             for bucket in self.buckets:
                 ids = jnp.zeros((self.max_batch, bucket), jnp.int32)
-                self._prefill[bucket] = (
-                    jax.jit(prefill_fn).lower(self.params, ids).compile())
+                self._prefill[bucket] = self._time_compile(
+                    f"prefill_b{bucket}",
+                    lambda ids=ids: jax.jit(prefill_fn)
+                    .lower(self.params, ids).compile())
         # decode: donate the cache (argnum 1). Abstract cache avals suffice
         # for lowering — no need to execute a real prefill at startup
         # (_cache_avals also pins them replicated under a mesh).
         cache0 = self._cache_avals()
         tok = jnp.zeros((self.max_batch, 1), jnp.int32)
-        self._decode = (
-            jax.jit(decode_fn, donate_argnums=(1,)).lower(self.params, cache0, tok).compile()
-        )
+        self._decode = self._time_compile(
+            "decode",
+            lambda: jax.jit(decode_fn, donate_argnums=(1,))
+            .lower(self.params, cache0, tok).compile())
         return self
 
     def compile_decode_fused(self, steps: int, sampler: Optional[Sampler] = None,
@@ -306,10 +333,11 @@ class CausalLM:
         cache0 = self._cache_avals()
         tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
         done0 = jnp.zeros((self.max_batch,), bool)
-        self._decode_fused[key] = (
-            jax.jit(fused_fn, donate_argnums=(1,))
-            .lower(self.params, cache0, tok0, jax.random.key(0), done0).compile()
-        )
+        self._decode_fused[key] = self._time_compile(
+            f"decode_fused_k{steps}",
+            lambda: jax.jit(fused_fn, donate_argnums=(1,))
+            .lower(self.params, cache0, tok0, jax.random.key(0), done0)
+            .compile())
         return self._decode_fused[key]
 
     def _cache_avals(self) -> PyTree:
@@ -414,8 +442,9 @@ class CausalLM:
             return toks, self._replicate_out(cache), tok, lengths, done
 
         b = self.max_batch
-        self._session_fused[key] = (
-            jax.jit(fused_fn, donate_argnums=(1,))
+        self._session_fused[key] = self._time_compile(
+            f"session_fused_k{steps}",
+            lambda: jax.jit(fused_fn, donate_argnums=(1,))
             .lower(self.params, self._cache_avals(),
                    jnp.zeros((b, 1), jnp.int32),
                    jax.random.split(jax.random.key(0), b),
@@ -423,8 +452,7 @@ class CausalLM:
                    jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
                    jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
                    jnp.ones((b,), jnp.float32), jnp.ones((b,), bool))
-            .compile()
-        )
+            .compile())
         return self._session_fused[key]
 
     def _bucket_for(self, s: int) -> int:
@@ -512,8 +540,10 @@ class CausalLM:
                     return logits, mut["cache"]
 
                 ids0 = jnp.zeros((rows, bucket), jnp.int32)
-                self._insert_prefill[pkey] = (
-                    jax.jit(prefill_fn).lower(self.params, ids0).compile())
+                self._insert_prefill[pkey] = self._time_compile(
+                    f"insert_prefill_r{rows}_b{bucket}",
+                    lambda: jax.jit(prefill_fn)
+                    .lower(self.params, ids0).compile())
         if rows not in self._insert_scatter:
             # pin the scatter OUTPUT to replicated: under a TP mesh the
             # freshly prefilled rows arrive head-sharded, and a plain jit
@@ -598,8 +628,9 @@ class CausalLM:
             return logits, self._replicate_out(
                 jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
 
-        self._paged_insert[key] = (
-            jax.jit(insert_fn, donate_argnums=(1,))
+        self._paged_insert[key] = self._time_compile(
+            f"paged_insert_r{rows}_b{bucket}",
+            lambda: jax.jit(insert_fn, donate_argnums=(1,))
             .lower(self.params, self._cache_avals(),
                    jnp.zeros((rows, bucket), jnp.int32),
                    jnp.zeros((rows, ppseq), jnp.int32),
@@ -664,8 +695,9 @@ class CausalLM:
             return logits, self._replicate_out(
                 jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
 
-        self._chunk_extend[key] = (
-            jax.jit(extend_fn, donate_argnums=(1,))
+        self._chunk_extend[key] = self._time_compile(
+            f"chunk_extend_r{rows}_b{bucket}",
+            lambda: jax.jit(extend_fn, donate_argnums=(1,))
             .lower(self.params, self._cache_avals(),
                    jnp.zeros((rows, bucket), jnp.int32),
                    jnp.zeros((rows,), jnp.int32),

@@ -89,12 +89,14 @@ import json
 import os
 import time
 from collections import deque
+from collections.abc import MutableMapping
 from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuronx_distributed_tpu.observability import MetricsRegistry, Tracer
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
 from neuronx_distributed_tpu.inference.faults import (
     DispatchFailed,
@@ -141,7 +143,10 @@ class Completion:
     decode_blocks: int              # blocks from insert to retirement
     ttft_blocks: int = 0            # arrival -> first token (virtual blocks)
     # wall perf_counter stamp per emitted token (the block fetch that
-    # surfaced it) — what the inter-token-latency report is computed from
+    # surfaced it) — the replay/recovery bookkeeping's record of what was
+    # already delivered; the inter-token-latency REPORT reads the tracer's
+    # token events instead (run_trace — single source of truth with the
+    # Perfetto export)
     token_ts: Optional[np.ndarray] = None
     cancelled: bool = False
     # deadline surface: ``expired`` = the ENGINE cut the request off when
@@ -176,6 +181,58 @@ class _PrefillInFlight:
     slot: int
     written: int                    # prompt tokens in KV (incl. reused prefix)
     chunk: Optional[ChunkedPrefill] = None
+
+
+# the engine's pre-observability counter set: every key the legacy
+# ``engine.stats`` dict carried, now backed by MetricsRegistry counters
+# (exposition name ``serve_<key>``) through the dict-compatible view below —
+# the parity test in tests/test_observability.py pins this list
+_STAT_KEYS = (
+    "blocks", "decode_blocks", "inserts", "inserted_requests",
+    "program_calls", "host_fetches", "deferred_admissions",
+    "chunk_program_calls", "prefill_chunk_tokens_done", "prefill_aborts",
+    "cancelled", "rejected", "shed_evictions", "expired",
+    "dispatch_retries", "corrupt_page_replays", "restored_requests",
+)
+
+
+class _StatsView(MutableMapping):
+    """Dict-compatible view over :class:`MetricsRegistry` counters: the
+    legacy ``engine.stats["blocks"] += 1`` surface keeps working verbatim
+    while the SAME store feeds the Prometheus exposition (one counter, two
+    read paths — no drift possible). New keys register on first write, so
+    ad-hoc ``setdefault`` counters keep working too."""
+
+    def __init__(self, registry: MetricsRegistry, keys=(),
+                 prefix: str = "serve_"):
+        self._reg = registry
+        self._prefix = prefix
+        self._counters = {k: registry.counter(prefix + k) for k in keys}
+
+    def __getitem__(self, k):
+        c = self._counters.get(k)
+        if c is None:
+            raise KeyError(k)
+        return c.value
+
+    def __setitem__(self, k, v) -> None:
+        c = self._counters.get(k)
+        if c is None:
+            c = self._reg.counter(self._prefix + k)
+            self._counters[k] = c
+        c.set(v)
+
+    def __delitem__(self, k) -> None:
+        raise TypeError("stats counters cannot be deleted")
+
+    def __iter__(self):
+        return iter(self._counters)
+
+    def __len__(self) -> int:
+        return len(self._counters)
+
+    def __repr__(self) -> str:
+        return repr(dict(self))
 
 
 class ServeEngine:
@@ -220,6 +277,9 @@ class ServeEngine:
         faults: Optional[Union[FaultPlan, FaultInjector]] = None,
         dispatch_retries: int = 3,
         dispatch_backoff_s: float = 0.001,
+        trace: bool = False,
+        tracer: Optional[Tracer] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
@@ -262,6 +322,29 @@ class ServeEngine:
         if faults is not None:
             self._injector = (faults if isinstance(faults, FaultInjector)
                               else FaultInjector(faults))
+        # observability: the tracer records structured lifecycle/dispatch
+        # events (disabled by default — one boolean check per seam); the
+        # registry backs BOTH the Prometheus exposition and the legacy
+        # ``stats`` dict view. Neither touches device programs: every event
+        # derives from data the scheduler already holds between blocks.
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=bool(trace))
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # compile spans from lazily-compiled programs land on this tracer.
+        # An ENABLED tracer always takes the lm; a disabled one only fills
+        # a vacancy — a warm-up engine sharing the lm must not detach the
+        # serving engine's tracer
+        if self.tracer.enabled or getattr(lm, "tracer", None) is None:
+            lm.tracer = self.tracer
+        self._m_ttft = self.metrics.histogram(
+            "serve_ttft_ms", help="wall submit->first-token latency")
+        self._m_itl = self.metrics.histogram(
+            "serve_itl_ms", help="wall gap between token deliveries")
+        self._m_queue = self.metrics.gauge(
+            "serve_queue_depth", help="arrived admission backlog")
+        self._disp_hist: Dict[str, object] = {}
+        self._submit_ts: Dict[int, float] = {}
+        self._last_tok_ts: Dict[int, float] = {}
         # base key: request r's token t draws from fold_in(fold_in(rng, r), t)
         self.rng = rng if rng is not None else jax.random.key(0)
         if lm._decode is None:
@@ -304,14 +387,12 @@ class ServeEngine:
         # consults the prefix index + page allocator — a prefix hit prefills
         # only the suffix, pool pressure defers admission instead of OOMing
         self.paged = bool(getattr(lm, "paged", False))
-        self.stats = {"blocks": 0, "decode_blocks": 0, "inserts": 0,
-                      "inserted_requests": 0, "program_calls": 0,
-                      "host_fetches": 0, "deferred_admissions": 0,
-                      "chunk_program_calls": 0, "prefill_chunk_tokens_done": 0,
-                      "prefill_aborts": 0, "cancelled": 0,
-                      "rejected": 0, "shed_evictions": 0, "expired": 0,
-                      "dispatch_retries": 0, "corrupt_page_replays": 0,
-                      "restored_requests": 0}
+        if self.paged and self.session.paged is not None:
+            self.session.paged.attach_observability(self.tracer, self.metrics)
+            self._m_pool = self.metrics.gauge(
+                "serve_page_pool_in_use", help="allocated KV pages")
+        # legacy counter surface, now a registry-backed view (see _StatsView)
+        self.stats = _StatsView(self.metrics, _STAT_KEYS)
 
     # --- submission ------------------------------------------------------
 
@@ -382,6 +463,17 @@ class ServeEngine:
                 arrival_block, deadline_ms, "deadline_ms"),
         )
         self._next_id += 1
+        now = time.perf_counter()
+        self._submit_ts[req.request_id] = now
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "submit", ("req", req.request_id), block=self.blocks,
+                ts=now,
+                args={"prompt_len": int(prompt.size),
+                      "max_new_tokens": int(max_new_tokens),
+                      "arrival_block": int(arrival_block),
+                      "ttft_deadline_block": req.ttft_deadline_block,
+                      "deadline_block": req.deadline_block})
         # bound the ARRIVED backlog at submit time (the live-client path);
         # future-arrival submissions are scheduled arrivals, not queue
         # pressure — they are shed at the block boundary where they arrive
@@ -393,6 +485,7 @@ class ServeEngine:
             if arrived >= self.max_queue + len(self._free_slots()):
                 return self._shed(req)
         self.queue.append(req)
+        self._m_queue.set(len(self.queue))
         return req.request_id
 
     def cancel(self, request_id: int) -> bool:
@@ -405,6 +498,10 @@ class ServeEngine:
             if r.request_id == request_id:
                 del self.queue[i]
                 self.stats["cancelled"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant("cancel", ("req", request_id),
+                                        block=self.blocks,
+                                        args={"state": "queued"})
                 return True
         for i, (req, pregen, ts) in enumerate(self._replay_q):
             if req.request_id == request_id:
@@ -421,6 +518,10 @@ class ServeEngine:
             if st.req.request_id == request_id:
                 self._abort_prefill(slot, requeue=False)
                 self.stats["cancelled"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant("cancel", ("req", request_id),
+                                        block=self.blocks,
+                                        args={"state": "prefill"})
                 return True
         for slot, req in enumerate(self.slots):
             if req is not None and req.request_id == request_id:
@@ -517,6 +618,12 @@ class ServeEngine:
                                        if r.arrival_block <= self.blocks))
         self.rejected.append(rej)
         self.stats["rejected"] += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "shed", ("req", victim.request_id), block=self.blocks,
+                args={"policy": self.shed_policy,
+                      "retry_after_blocks": rej.retry_after_blocks,
+                      "queue_depth": rej.queue_depth})
         return rej if victim is req else req.request_id
 
     def _shed_overflow(self) -> None:
@@ -545,22 +652,53 @@ class ServeEngine:
                 retry_after_blocks=self._retry_after(),
                 queue_depth=len(arrived) - 1))
             self.stats["rejected"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "shed", ("req", victim.request_id), block=self.blocks,
+                    args={"policy": self.shed_policy, "at": "block_boundary",
+                          "queue_depth": len(arrived) - 1})
 
     def _dispatch(self, kind: str, fn):
         """Run one compiled-program dispatch with transient-failure
         retry+exponential backoff. The fault injector (when armed) raises
         BEFORE ``fn`` executes, so a retried dispatch never re-runs device
         work; past the retry budget the failure escalates to
-        :class:`DispatchFailed` (fail-stop — snapshot/restore recovers)."""
+        :class:`DispatchFailed` (fail-stop — snapshot/restore recovers).
+
+        This is also the dispatch-latency observation point: every
+        successful dispatch lands in the ``serve_dispatch_ms{kind=...}``
+        histogram and (when tracing) an X span on the engine dispatch lane
+        with its retry count; each injected/transient failure is an instant
+        on the faults lane."""
         attempts = 0
+        hist = self._disp_hist.get(kind)
+        if hist is None:
+            hist = self._disp_hist[kind] = self.metrics.histogram(
+                "serve_dispatch_ms", help="compiled-program dispatch wall ms",
+                kind=kind)
         while True:
             try:
                 if self._injector is not None:
                     self._injector.before_dispatch(kind)
-                return fn()
+                t0 = time.perf_counter()
+                out = fn()
+                t1 = time.perf_counter()
+                hist.observe((t1 - t0) * 1e3)
+                if self.tracer.enabled:
+                    self.tracer.complete(
+                        kind, ("engine", "dispatch"), t0, t1,
+                        block=self.blocks,
+                        args={"retries": attempts} if attempts else None)
+                return out
             except TransientDispatchError as e:
                 attempts += 1
                 self.stats["dispatch_retries"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "fault:dispatch", ("engine", "faults"),
+                        block=self.blocks,
+                        args={"kind": kind, "attempt": attempts,
+                              "error": str(e)})
                 if attempts > self.dispatch_retries:
                     raise DispatchFailed(
                         f"{kind} dispatch failed {attempts} times "
@@ -572,6 +710,15 @@ class ServeEngine:
     def _completion_of(self, req: Request, cancelled: bool = False,
                        expired: bool = False) -> Completion:
         ts = self._out_ts.pop(req.request_id, [])
+        self._submit_ts.pop(req.request_id, None)
+        self._last_tok_ts.pop(req.request_id, None)
+        if self.tracer.enabled:
+            kind = ("cancel" if cancelled else
+                    "expire" if expired else "retire")
+            self.tracer.instant(
+                kind, ("req", req.request_id), block=self.blocks,
+                args={"generated": len(self._out.get(req.request_id, [])),
+                      "deadline_missed": bool(expired or self._missed(req))})
         return Completion(
             request_id=req.request_id,
             tokens=np.asarray(self._out.pop(req.request_id, []), np.int64),
@@ -597,12 +744,49 @@ class ServeEngine:
         self._active[slot] = False
         self._done[slot] = False
 
+    def _trace_queued(self, req: Request, now: float) -> None:
+        """Close the request's 'queued' lifecycle span (submit wall stamp ->
+        the moment a slot claimed it)."""
+        if not self.tracer.enabled:
+            return
+        sts = self._submit_ts.get(req.request_id, now)
+        self.tracer.complete(
+            "queued", ("req", req.request_id), sts, now, block=self.blocks,
+            args={"queue_blocks": max(self.blocks - req.arrival_block, 0)})
+
+    def _observe_first_token(self, req: Request, slot: int, now: float,
+                             **extra) -> None:
+        """First-token observation shared by the admission paths (one-shot
+        insert, chunked-prefill finish, fresh recovery replay): wall-TTFT
+        histogram + admit/first_token marks on the request lane."""
+        sts = self._submit_ts.get(req.request_id)
+        if sts is not None:
+            self._m_ttft.observe((now - sts) * 1e3)
+        if not self.tracer.enabled:
+            return
+        rid = req.request_id
+        self.tracer.instant(
+            "admit", ("req", rid), ts=now, block=self.blocks,
+            args={"slot": int(slot),
+                  **{k: v for k, v in extra.items() if v is not None}})
+        self.tracer.instant("first_token", ("req", rid), ts=now,
+                            block=self.blocks,
+                            args={"ttft_blocks": max(
+                                self.blocks - req.arrival_block, 0)})
+
     def _expire_request(self, req: Request) -> None:
         """Deadline passed before (or while) prefill: deliver an empty
         ``expired`` completion — the client learns NOW instead of after
         wasted prefill + decode."""
         self._out.pop(req.request_id, None)
         self._out_ts.pop(req.request_id, None)
+        self._submit_ts.pop(req.request_id, None)
+        self._last_tok_ts.pop(req.request_id, None)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "expire", ("req", req.request_id), block=self.blocks,
+                args={"generated": 0, "state": "pre_decode",
+                      "deadline_missed": True})
         self.completed.append(Completion(
             request_id=req.request_id, tokens=np.zeros((0,), np.int64),
             prompt_len=req.prompt.size,
@@ -742,6 +926,8 @@ class ServeEngine:
         for i, (r, slot) in enumerate(zip(group, slot_ids)):
             r.start_block = self.blocks
             r.first_token_block = self.blocks
+            self._trace_queued(r, now)
+            self._observe_first_token(r, slot, now, bucket=bucket, rows=rows)
             self.slots[slot] = r
             self._out[r.request_id] = []
             self._out_ts[r.request_id] = []
@@ -770,6 +956,12 @@ class ServeEngine:
                 req.prompt.size + req.max_new_tokens + self.block_steps)
             written = chunk.start           # prefix hit: skip reused pages
         req.start_block = self.blocks
+        self._trace_queued(req, time.perf_counter())
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "chunk_begin", ("req", req.request_id), block=self.blocks,
+                args={"slot": int(slot), "prompt_len": int(req.prompt.size),
+                      "prefix_reused_tokens": int(written)})
         self.slots[slot] = req
         self._active[slot] = False
         self._done[slot] = False
@@ -812,6 +1004,12 @@ class ServeEngine:
             self.stats["prefill_chunk_tokens_done"] += n
             st.written += n
             budget -= n
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "prefill_chunk", ("req", req.request_id),
+                    block=self.blocks,
+                    args={"tokens": int(n), "written": int(st.written),
+                          "of": int(req.prompt.size), "final": bool(final)})
             if final:
                 self._finish_prefill(slot, st, logits)
 
@@ -837,6 +1035,8 @@ class ServeEngine:
         first = int(np.asarray(self.slot_sampler(
             logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
         req.first_token_block = self.blocks
+        self._observe_first_token(req, slot, time.perf_counter(),
+                                  chunked=True)
         self._out[req.request_id] = []
         self._out_ts[req.request_id] = []
         self._lengths[slot] = req.prompt.size
@@ -869,6 +1069,11 @@ class ServeEngine:
         self.session.lengths[slot] = 0
         self.session.active[slot] = False
         self.stats["prefill_aborts"] += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "prefill_abort", ("req", st.req.request_id),
+                block=self.blocks,
+                args={"requeue": bool(requeue), "written": int(st.written)})
         if requeue:
             st.req.start_block = None
             self.queue.appendleft(st.req)
@@ -971,6 +1176,12 @@ class ServeEngine:
         self._tok[slot] = tok
         self._slot_keys = self._slot_keys.at[slot].set(key)
         self._gen_counts[slot] = g + 1
+        if g == 0:
+            self._observe_first_token(req, slot, now, replayed=True)
+        elif self.tracer.enabled:
+            self.tracer.instant(
+                "replay_admit", ("req", req.request_id), block=self.blocks,
+                args={"slot": int(slot), "resumed_at": int(g)})
         self._record(slot, tok, now)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
@@ -1009,6 +1220,11 @@ class ServeEngine:
         bit-identical (per-request rng)."""
         pkv = self.session.paged
         bad = {int(p) for p in pages}
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "fault:corrupt_pages", ("engine", "faults"),
+                block=self.blocks,
+                args={"pages": sorted(bad)})
         self._corrupt_page_bytes(sorted(bad))
         if pkv.prefix is not None:
             pkv.prefix.invalidate_pages(sorted(bad))
@@ -1029,6 +1245,11 @@ class ServeEngine:
             self._done[slot] = False
             self._replay_q.append((req, pregen, ts))
             self.stats["corrupt_page_replays"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "corrupt_replay", ("req", req.request_id),
+                    block=self.blocks,
+                    args={"delivered": len(pregen)})
         self._drain_replays()
 
     # --- snapshot / restore ------------------------------------------------
@@ -1097,10 +1318,12 @@ class ServeEngine:
         """Crash-safe snapshot write (tmp + atomic rename): a reader never
         sees a half-written file, so a crash DURING the snapshot leaves the
         previous one intact."""
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f)
-        os.replace(tmp, path)
+        with self.tracer.span("snapshot_save", ("engine", "snapshot"),
+                              block=self.blocks):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f)
+            os.replace(tmp, path)
 
     @classmethod
     def from_snapshot(cls, lm: CausalLM, snap: Union[dict, str],
@@ -1146,6 +1369,10 @@ class ServeEngine:
                 # before queued entries, so they keep admission priority)
                 eng.queue.append(req)
             eng.stats["restored_requests"] += 1
+        if eng.tracer.enabled:
+            eng.tracer.instant(
+                "restore", ("engine", "snapshot"), block=eng.blocks,
+                args={"requests": len(snap["requests"])})
         eng._drain_replays()
         return eng
 
@@ -1158,6 +1385,17 @@ class ServeEngine:
         out = self._out[req.request_id]
         out.append(token)
         self._out_ts[req.request_id].append(ts)
+        # delivery-gap surface: tokens of one fused fetch share a stamp, so
+        # only cross-delivery gaps (ts advanced) are observed — the user-
+        # experienced inter-token latency, same filter run_trace applies
+        last = self._last_tok_ts.get(req.request_id)
+        if last is not None and ts > last:
+            self._m_itl.observe((ts - last) * 1e3)
+        self._last_tok_ts[req.request_id] = ts
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "tok", ("req", req.request_id), block=self.blocks, ts=ts,
+                args={"t": int(token), "i": len(out) - 1})
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._done[slot] = True
         if len(out) >= req.max_new_tokens:
@@ -1174,6 +1412,35 @@ class ServeEngine:
             self._complete_slot(slot)
 
     # --- the block loop --------------------------------------------------
+
+    def _observe_block(self) -> None:
+        """Per-block level sampling (host-side, one call per scheduling
+        round): arrived backlog depth and — in paged mode — page-pool
+        occupancy, as gauges plus Perfetto counter tracks when tracing."""
+        depth = sum(1 for r in self.queue if r.arrival_block <= self.blocks)
+        self._m_queue.set(depth)
+        tr_on = self.tracer.enabled
+        if tr_on:
+            self.tracer.counter("queue_depth", ("engine", "queue"), depth,
+                                block=self.blocks)
+        if self.paged and self.session.paged is not None:
+            in_use = self.session.paged.allocator.in_use()
+            self._m_pool.set(in_use)
+            if tr_on:
+                self.tracer.counter("pages_in_use", ("cache", "pool"),
+                                    in_use, block=self.blocks)
+
+    def _fetch(self, arr) -> np.ndarray:
+        """The block's host fetch, as an observable span: device->host copy
+        of the emitted token matrix (the 2nd of the <= 2 host ops per fused
+        block)."""
+        if not self.tracer.enabled:
+            return np.asarray(arr)
+        t0 = time.perf_counter()
+        out = np.asarray(arr)
+        self.tracer.complete("fetch", ("engine", "dispatch"), t0,
+                             time.perf_counter(), block=self.blocks)
+        return out
 
     def step_block(self) -> bool:
         """One scheduling round: drain recovery replays, admit (expire/shed
@@ -1193,6 +1460,7 @@ class ServeEngine:
                 self.session.paged.live_pages())
             if victims:
                 self._handle_corrupt_pages(victims)
+        self._observe_block()
         if not self._active.any():
             if (not self.queue and not self._prefilling
                     and not self._replay_q):
@@ -1202,8 +1470,15 @@ class ServeEngine:
             self.blocks += 1
             self.stats["blocks"] += 1
             return True
+        t0 = time.perf_counter()
         toks = self._advance_block()
         now = time.perf_counter()
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "decode_block", ("engine", "blocks"), t0, now,
+                block=self.blocks,
+                args={"active": int(self._active.sum()),
+                      "steps": self.block_steps, "fused": self.fused})
         self.stats["blocks"] += 1
         self.stats["decode_blocks"] += 1
         # mirror the device latches from the one fetch (K, b)
@@ -1241,7 +1516,7 @@ class ServeEngine:
             self.session.lengths = self.session.lengths + self.block_steps
             self.stats["program_calls"] += 1
             self.stats["host_fetches"] += 1
-            return np.asarray(toks)
+            return self._fetch(toks)
         out = np.zeros((self.block_steps, self.lm.max_batch), np.int64)
         done = self._done.copy()
         temp = jnp.asarray(self._temp)
@@ -1264,7 +1539,8 @@ class ServeEngine:
                     jnp.asarray(t[:, None], jnp.int32)))
             self.session.cache = cache
             self.session.lengths += 1
-            nxt = np.asarray(self.slot_sampler(logits[:, 0], sub, temp, greedy))
+            nxt = self._fetch(self.slot_sampler(logits[:, 0], sub, temp,
+                                                greedy))
             self.stats["program_calls"] += 1
             self.stats["host_fetches"] += 1
             out[i] = np.where(done | ~self._active, self.pad_token_id, nxt)
@@ -1274,6 +1550,39 @@ class ServeEngine:
             done = done | (self._active & (lengths + 1 >= max_len))
             tok = nxt.astype(np.int32)
         return out
+
+    # --- observability surface -------------------------------------------
+
+    def request_timeline(self, request_id: int) -> List[dict]:
+        """The request's recorded lifecycle, oldest first: one dict per
+        event with wall ``ts_ms`` (tracer epoch), the virtual ``block``,
+        span ``dur_ms`` where applicable, and the event args. Empty when
+        tracing was off (or the events aged out of the ring buffer) —
+        enable with ``ServeEngine(trace=True)``."""
+        picked = [(i, ev) for i, ev in enumerate(self.tracer.events())
+                  if ev["lane"] == ("req", request_id)]
+        # time order with recording order as the tiebreak: a lifecycle span
+        # (e.g. 'queued') starts at an earlier stamp than the instant
+        # recorded just before it
+        picked.sort(key=lambda t: (t[1]["ts"], t[0]))
+        out = []
+        for _, ev in picked:
+            d = {"name": ev["name"],
+                 "ts_ms": round((ev["ts"] - self.tracer._t0) * 1e3, 3),
+                 "block": ev["block"], "args": ev["args"] or {}}
+            if ev["ph"] == "X":
+                d["dur_ms"] = round(ev["dur"] * 1e3, 3)
+            out.append(d)
+        return out
+
+    def _sync_compile_metrics(self) -> None:
+        """Mirror the lm's per-program compile timings (recorded once per
+        signature at compile time, engine-independent) into the registry so
+        the exposition carries the compile-vs-execute split."""
+        for sig, ms in getattr(self.lm, "compile_ms", {}).items():
+            self.metrics.gauge(
+                "compile_ms", help="first-call XLA compile wall ms",
+                program=sig).set(ms)
 
     def run(self, max_blocks: Optional[int] = None,
             snapshot_path: Optional[str] = None,
@@ -1293,9 +1602,11 @@ class ServeEngine:
             if snapshot_path and n % every == 0:
                 self.save_snapshot(snapshot_path)
             if max_blocks is not None and n >= max_blocks:
+                self._sync_compile_metrics()
                 return self.completed
         if snapshot_path and os.path.exists(snapshot_path):
             os.remove(snapshot_path)   # clean drain: nothing to recover
+        self._sync_compile_metrics()
         return self.completed
 
 
@@ -1358,7 +1669,17 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     TTFT/inter-token-latency surface, host-op accounting, and — when the
     trace carries deadlines or the engine bounds its queue — the overload
     surface: rejected/expired counts, deadline-miss rate, goodput) used by
-    ``runner.py serve`` and the bench."""
+    ``runner.py serve`` and the bench.
+
+    The wall latency surface (inter-token delivery gaps, per-request max
+    stall) is computed from the TRACER's per-request token events — the
+    same single source of truth the Perfetto export and
+    :meth:`ServeEngine.request_timeline` read — so this entrypoint turns
+    tracing on when the engine was built without it. Callers measuring the
+    untraced fast path (the tracing-overhead bench) drive ``engine.run()``
+    directly."""
+    if not engine.tracer.enabled:
+        engine.tracer.enabled = True
     for item in trace:
         engine.submit(item["prompt"], item["max_new_tokens"],
                       eos_token_id=item.get("eos_token_id"),
@@ -1372,19 +1693,23 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     total_tokens = int(sum(len(c.tokens) for c in completions))
     decode_blocks = max(engine.stats["decode_blocks"], 1)
     # wall-clock latency surface: per-request TTFT (virtual blocks — wall
-    # arrivals would be backend-racy) and inter-token gaps from the block
-    # fetch stamps. A fused block DELIVERS its K tokens in one fetch, so
-    # the user-experienced inter-token latency is the gap between
-    # successive deliveries — intra-delivery gaps (identical stamps, 0.0)
-    # are excluded. A long-prompt one-shot insert shows up as ONE huge
-    # delivery gap on every concurrently-decoding request; chunked prefill
-    # bounds it, which is what pulls itl_p99 back toward the no-insert
-    # per-block baseline.
+    # arrivals would be backend-racy) and inter-token gaps from the
+    # tracer's per-token delivery stamps. A fused block DELIVERS its K
+    # tokens in one fetch (identical stamps), so the user-experienced
+    # inter-token latency is the gap between successive deliveries —
+    # intra-delivery zero gaps are excluded. A long-prompt one-shot insert
+    # shows up as ONE huge delivery gap on every concurrently-decoding
+    # request; chunked prefill bounds it, which is what pulls itl_p99 back
+    # toward the no-insert per-block baseline.
+    tok_ts = {
+        rid: np.asarray([ev["ts"] for ev in evs if ev["name"] == "tok"],
+                        np.float64)
+        for rid, evs in engine.tracer.by_request().items()}
     per_request = []
     gaps_ms: List[float] = []
     for c in completions:
-        g = (np.diff(c.token_ts) * 1e3 if c.token_ts is not None
-             and len(c.token_ts) > 1 else np.zeros((0,)))
+        ts = tok_ts.get(c.request_id, np.zeros((0,)))
+        g = np.diff(ts) * 1e3 if ts.size > 1 else np.zeros((0,))
         g = g[g > 0.0]
         gaps_ms.extend(g.tolist())
         per_request.append({
@@ -1463,6 +1788,10 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         "dispatch_retries": engine.stats["dispatch_retries"],
         "corrupt_page_replays": engine.stats["corrupt_page_replays"],
         "restored_requests": engine.stats["restored_requests"],
+        # tracing surface: how much of the timeline survives in the ring
+        # buffer (dropped > 0 means the export window is partial)
+        "trace_events": len(engine.tracer.events()),
+        "trace_events_dropped": engine.tracer.dropped,
     })
     if engine._injector is not None:
         report["fault_stats"] = dict(engine._injector.stats)

@@ -280,6 +280,44 @@ class PagedKVCache:
         self.stats = {"prefix_queries": 0, "prefix_hits": 0,
                       "prefix_hit_tokens": 0, "evicted_pages": 0,
                       "pages_in_use_peak": 0}
+        # observability (attach_observability): cache-lane trace events +
+        # prefix-hit-length histogram; None => zero-cost no-ops
+        self._tracer = None
+        self._m_prefix = None
+
+    # --- observability ---------------------------------------------------
+
+    def attach_observability(self, tracer, metrics) -> None:
+        """Wire the serving engine's tracer/registry into the cache seams:
+        prefix-hit lengths (histogram + instants), LRU evictions, and pool
+        exhaustion land on the ``cache`` timeline lane. Host-side only —
+        nothing here can touch a compiled program."""
+        self._tracer = tracer
+        self._m_prefix = metrics.histogram(
+            "serve_prefix_hit_tokens",
+            help="page-aligned prefix tokens reused per admission query",
+            lo=1.0)
+
+    def _note_prefix(self, shared: List[int]) -> None:
+        if self._m_prefix is not None:
+            self._m_prefix.observe(len(shared) * self.page_size)
+        if self._tracer is not None and self._tracer.enabled and shared:
+            self._tracer.instant(
+                "prefix_hit", ("cache", "pool"),
+                args={"tokens": len(shared) * self.page_size,
+                      "pages": len(shared)})
+
+    def _note_evict(self, freed: int) -> None:
+        if freed and self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant("evict", ("cache", "pool"),
+                                 args={"pages": int(freed)})
+
+    def _note_exhausted(self, need: int) -> None:
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                "pool_exhausted", ("cache", "pool"),
+                args={"need": int(need),
+                      "free": int(self.allocator.available())})
 
     # --- admission lifecycle --------------------------------------------
 
@@ -301,6 +339,7 @@ class PagedKVCache:
             if shared:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += len(shared) * ps
+            self._note_prefix(shared)
         start = len(shared) * ps
         total = min(max(int(reserve_total), plen), self.max_seq_len)
         n_owned = -(-total // ps) - len(shared)
@@ -310,11 +349,14 @@ class PagedKVCache:
         owned = self.allocator.alloc(n_owned)
         if owned is None:
             if self.prefix is not None:
-                self.stats["evicted_pages"] += self.prefix.evict(
+                freed = self.prefix.evict(
                     n_owned - self.allocator.available())
+                self.stats["evicted_pages"] += freed
+                self._note_evict(freed)
             owned = self.allocator.alloc(n_owned)
             if owned is None:
                 self.allocator.release(shared)
+                self._note_exhausted(n_owned)
                 raise PagePoolExhausted(
                     f"need {n_owned} pages, {self.allocator.available()} free")
         table = np.empty((self.pages_per_slot,), np.int32)
@@ -380,6 +422,7 @@ class PagedKVCache:
             if shared:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += len(shared) * ps
+            self._note_prefix(shared)
         self.allocator.retain(shared)
         return ChunkedPrefill(tokens=list(tokens),
                               reserve_total=int(reserve_total),
@@ -404,10 +447,12 @@ class PagedKVCache:
             return
         pages = self.allocator.alloc(need)
         if pages is None and self.prefix is not None:
-            self.stats["evicted_pages"] += self.prefix.evict(
-                need - self.allocator.available())
+            freed = self.prefix.evict(need - self.allocator.available())
+            self.stats["evicted_pages"] += freed
+            self._note_evict(freed)
             pages = self.allocator.alloc(need)
         if pages is None:
+            self._note_exhausted(need)
             raise PagePoolExhausted(
                 f"chunked prefill needs {need} pages, "
                 f"{self.allocator.available()} free")

@@ -48,6 +48,8 @@ class ModelCheckpoint(Callback):
         finalize_checkpoint()
 
     def _save(self, trainer, step: int) -> None:
+        import time
+
         from neuronx_distributed_tpu.checkpoint import save_checkpoint
 
         content = {"step": step}
@@ -55,9 +57,21 @@ class ModelCheckpoint(Callback):
             # data-stream position rides the checkpoint so resume seeks the
             # stream in O(1) instead of replaying next() step times
             content["data_state"] = trainer.train_stream.state_dict()
-        save_checkpoint(self.checkpoint_dir, f"step_{step}", trainer.state,
-                        user_content=content, async_save=self.async_save,
-                        num_kept=self.num_kept)
+        t0 = time.perf_counter()
+        tracer = getattr(trainer, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            with tracer.span(f"checkpoint_{step}", ("trainer", "checkpoint")):
+                save_checkpoint(self.checkpoint_dir, f"step_{step}",
+                                trainer.state, user_content=content,
+                                async_save=self.async_save,
+                                num_kept=self.num_kept)
+        else:
+            save_checkpoint(self.checkpoint_dir, f"step_{step}",
+                            trainer.state, user_content=content,
+                            async_save=self.async_save,
+                            num_kept=self.num_kept)
+        if getattr(trainer, "_m_ckpt", None) is not None:
+            trainer._m_ckpt.observe((time.perf_counter() - t0) * 1e3)
 
 
 class ProgressLogger(Callback):

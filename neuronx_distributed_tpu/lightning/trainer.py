@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 from typing import Any, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -46,7 +47,13 @@ class NxDTrainer:
         checkpoint_dir: Optional[str] = None,
         seed: int = 0,
         handle_preemption: bool = True,
+        tracer=None,
+        metrics=None,
     ):
+        from neuronx_distributed_tpu.observability import (
+            MetricsRegistry, Tracer,
+        )
+
         self.max_steps = int(max_steps)
         self.callbacks = list(callbacks)
         self.logger = logger_
@@ -54,6 +61,20 @@ class NxDTrainer:
         self.val_steps = int(val_steps)
         self.checkpoint_dir = checkpoint_dir
         self.seed = seed
+        # observability: the fit loop records one span per step and per
+        # checkpoint save on the "trainer" lanes, plus a step-time histogram
+        # / tokens-per-sec gauge in the registry. Disabled tracer (the
+        # default) costs one boolean check per step.
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._m_step = self.metrics.histogram(
+            "train_step_ms", help="per-step dispatch+sync wall ms")
+        self._m_ckpt = self.metrics.histogram(
+            "train_checkpoint_ms", help="checkpoint save-call wall ms")
+        self._m_tok = self.metrics.gauge(
+            "train_tokens_per_sec", help="tokens/s over the last step")
+        self._m_steps = self.metrics.counter(
+            "train_steps", help="optimizer steps run")
         self.model = None
         self.optimizer = None
         self.state = None
@@ -148,9 +169,22 @@ class NxDTrainer:
             for i in range(start, self.max_steps):
                 batch = pending if pending is not None else next(stream_it)
                 pending = None
+                t0 = time.perf_counter()
                 with step_annotation(i):
                     self.state, metrics = step_fn(
                         self.state, batch, jax.random.key(self.seed + i + 1))
+                t1 = time.perf_counter()
+                self._m_step.observe((t1 - t0) * 1e3)
+                self._m_steps.inc()
+                tokens = sum(
+                    int(np.prod(v.shape)) for v in batch.values()
+                    if getattr(v, "ndim", 0) >= 2)
+                if t1 > t0 and tokens:
+                    self._m_tok.set(round(tokens / (t1 - t0), 1))
+                if self.tracer.enabled:
+                    self.tracer.complete(
+                        f"step_{i}", ("trainer", "steps"), t0, t1,
+                        args={"step": i + 1, "tokens": tokens})
                 step = i + 1
                 if self.logger is not None:
                     self.logger.log_metrics(metrics, step)
@@ -201,7 +235,11 @@ class NxDTrainer:
         content: Dict[str, Any] = {"step": step, "preempted": True}
         if self.train_stream is not None:
             content["data_state"] = self.train_stream.state_dict()
-        save_checkpoint(self.checkpoint_dir, f"step_{step}", self.state,
-                        user_content=content, async_save=False)
-        finalize_checkpoint()
+        t0 = time.perf_counter()
+        with self.tracer.span(f"preemption_checkpoint_{step}",
+                              ("trainer", "checkpoint")):
+            save_checkpoint(self.checkpoint_dir, f"step_{step}", self.state,
+                            user_content=content, async_save=False)
+            finalize_checkpoint()
+        self._m_ckpt.observe((time.perf_counter() - t0) * 1e3)
         logger.warning("preemption checkpoint saved at step %d", step)

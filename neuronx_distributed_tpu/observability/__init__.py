@@ -1,0 +1,32 @@
+"""Host-side observability: structured tracing (per-request timelines,
+Perfetto/Chrome export) + a Prometheus-style metrics registry.
+
+Wired through the serving engine (``inference/engine.py`` — request
+lifecycle lanes, dispatch/fault/snapshot spans), the paged KV cache
+(prefix hits, evictions, pool pressure), the CausalLM program cache
+(per-signature compile timing) and the trainer step loop. Disabled-by-
+default zero-cost: a disabled tracer is one boolean check per seam, and no
+instrument ever touches a compiled program's signature.
+"""
+
+from neuronx_distributed_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    parse_prometheus,
+)
+from neuronx_distributed_tpu.observability.tracer import (
+    Tracer,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "parse_prometheus",
+    "Tracer",
+    "validate_chrome_trace",
+]

@@ -2,13 +2,39 @@
 
 The dispatch contracts (<= 2 host ops per fused K-token block; zero
 step-decode calls for fused tails; exactly one chunk-extend dispatch per
-prefill chunk) must be proven by counting COMPILED-PROGRAM invocations
-independently of the engine's self-reported stats. test_serving_engine.py
-and test_paged_cache.py used to re-implement these wrappers inline; the
-chunked-prefill suite made a third copy inevitable, so they live here.
+prefill chunk) must be proven by counting dispatches independently of the
+engine's self-reported stats. Since the observability PR the PRIMARY
+counting surface is the engine TRACER (:func:`dispatch_counts` /
+:func:`decode_host_ops_per_block` — every ``_dispatch`` lands one X span on
+the engine dispatch lane, every block fetch one ``fetch`` span), which also
+proves the contracts hold WITH TRACING ON. The monkeypatch wrappers below
+are kept as the one tracer-independent cross-check
+(test_serving_engine.py's dispatch-count test pins tracer == monkeypatch ==
+stats on the same run); other suites consume tracer events.
 """
 
 import contextlib
+
+
+def dispatch_counts(engine, kind=None):
+    """Dispatch-span counts from the engine tracer, by program kind
+    ('insert' / 'extend' / 'decode', plus 'fetch' for the block's
+    device->host copy). Requires the engine to run with ``trace=True``.
+    Returns the {kind: count} dict, or one count when ``kind`` is given."""
+    counts = {}
+    for ev in engine.tracer.events(lane_group="engine"):
+        if ev["lane"] == ("engine", "dispatch") and ev["ph"] == "X":
+            counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    return counts.get(kind, 0) if kind is not None else counts
+
+
+def decode_host_ops_per_block(engine):
+    """Decode-side host ops per decode block, tracer-counted: program
+    dispatches named 'decode' plus 'fetch' spans over the engine's decode
+    blocks — 2.0 is the fused contract, 2*K the stepwise baseline."""
+    c = dispatch_counts(engine)
+    blocks = max(engine.stats["decode_blocks"], 1)
+    return (c.get("decode", 0) + c.get("fetch", 0)) / blocks
 
 
 class CallCounter:

@@ -31,7 +31,7 @@ from neuronx_distributed_tpu.inference.paged_cache import (
     PagePoolExhausted,
 )
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-from tests.helpers import count_factory_calls
+from tests.helpers import decode_host_ops_per_block, dispatch_counts
 
 TINY = dict(
     vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
@@ -61,8 +61,8 @@ def _prompts(n, s=8, seed=2):
     return np.array(jax.random.randint(jax.random.PRNGKey(seed), (n, s), 1, 127))
 
 
-def _run(lm, submits, fused=True, chunk=0, rng_seed=42):
-    eng = ServeEngine(lm, block_steps=K, fused=fused,
+def _run(lm, submits, fused=True, chunk=0, rng_seed=42, trace=False):
+    eng = ServeEngine(lm, block_steps=K, fused=fused, trace=trace,
                       prefill_chunk_tokens=chunk, rng=jax.random.key(rng_seed))
     ids = [eng.submit(**kw) for kw in submits]
     comps = {c.request_id: c for c in eng.run()}
@@ -141,19 +141,22 @@ def test_decode_advances_during_chunked_prefill(stack):
 
 def test_chunked_dispatch_contract(stack):
     """The fused decode half keeps <= 2 host ops per K-token block under
-    chunking (independently counted), and chunk extends are accounted
-    separately — exactly one extend dispatch per chunk."""
+    chunking, counted from the engine tracer's dispatch spans (so the
+    contract is also proven WITH tracing on), and chunk extends are
+    accounted separately — exactly one extend dispatch per chunk."""
     cfg, params, lm_c, lm_p = stack
     p = _prompts(1, s=8, seed=13)[0]
     long16 = _prompts(1, s=16, seed=15)[0]
-    with count_factory_calls(lm_c, "compile_session_decode_fused") as calls:
-        eng, res = _run(lm_c, [dict(prompt=p, max_new_tokens=10),
-                               dict(prompt=long16, max_new_tokens=5,
-                                    arrival_block=1)], chunk=4)
-    assert calls.n == eng.stats["decode_blocks"] >= 2
-    assert eng.stats["program_calls"] == eng.stats["host_fetches"] == calls.n
+    eng, res = _run(lm_c, [dict(prompt=p, max_new_tokens=10),
+                           dict(prompt=long16, max_new_tokens=5,
+                                arrival_block=1)], chunk=4, trace=True)
+    counts = dispatch_counts(eng)
+    assert counts["decode"] == eng.stats["decode_blocks"] >= 2
+    assert eng.stats["program_calls"] == eng.stats["host_fetches"] \
+        == counts["decode"] == counts["fetch"]
+    assert decode_host_ops_per_block(eng) == 2.0
     # BOTH prompts exceed the 4-token budget, so both chunk: 8/4 + 16/4
-    assert eng.stats["chunk_program_calls"] == 8 // 4 + 16 // 4
+    assert eng.stats["chunk_program_calls"] == counts["extend"] == 8 // 4 + 16 // 4
     assert eng.stats["prefill_chunk_tokens_done"] == 8 + 16
 
 

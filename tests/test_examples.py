@@ -219,6 +219,72 @@ def test_inference_runner_serve_robustness_tiny(capsys):
     assert "fault_stats" in report
 
 
+def test_inference_runner_serve_trace_and_metrics_out(capsys, tmp_path):
+    """ISSUE 6 CI gate: runner.py serve --trace_out/--metrics_out writes
+    BOTH observability artifacts — the trace loads as valid Chrome
+    trace-event JSON (events sorted, pid/tid/ts/ph present, non-empty
+    per-request lanes with the full lifecycle), the metrics file parses as
+    Prometheus text exposition carrying the serve counters."""
+    import runner
+
+    from neuronx_distributed_tpu.observability import (
+        parse_prometheus, validate_chrome_trace,
+    )
+
+    trace_path = tmp_path / "serve_trace.json"
+    metrics_path = tmp_path / "serve_metrics.prom"
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3",
+                 "--prefill_chunk_tokens", "8",
+                 "--long_prompt_frac", "0.5", "--long_prompt_len", "24",
+                 "--trace_out", str(trace_path),
+                 "--metrics_out", str(metrics_path)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["trace_events"] > 0 and report["trace_events_dropped"] == 0
+
+    doc = json.loads(trace_path.read_text())
+    summary = validate_chrome_trace(doc)
+    assert len(summary["request_lanes"]) == 4
+    assert {"submit", "queued", "admit", "first_token", "tok", "retire",
+            "prefill_chunk", "decode_block", "decode", "fetch"} \
+        <= summary["names"]
+
+    fams = parse_prometheus(metrics_path.read_text())
+    assert fams["serve_inserted_requests"]["samples"][
+        ("serve_inserted_requests", ())] == 4.0
+    assert fams["serve_decode_blocks"]["type"] == "counter"
+    for family in ("serve_ttft_ms", "serve_itl_ms", "serve_dispatch_ms",
+                   "serve_queue_depth", "compile_ms"):
+        assert family in fams, family
+
+
+def test_bert_pretrain_trainer_trace_and_metrics_out(tmp_path):
+    """ISSUE 6 CI gate, trainer half: the shared train_loop writes a step
+    timeline (one span per step on the trainer lane) and a metrics
+    exposition (step-time histogram, tokens/s gauge) when asked."""
+    import bert_pretrain
+
+    from neuronx_distributed_tpu.observability import (
+        parse_prometheus, validate_chrome_trace,
+    )
+
+    trace_path = tmp_path / "train_trace.json"
+    metrics_path = tmp_path / "train_metrics.prom"
+    loss = bert_pretrain.main([
+        "--tiny", "--steps", "2", "--log_every", "1",
+        "--trace_out", str(trace_path), "--metrics_out", str(metrics_path)])
+    assert np.isfinite(loss)
+    doc = json.loads(trace_path.read_text())
+    summary = validate_chrome_trace(doc, require_request_lanes=False)
+    assert "trainer" in summary["processes"]
+    assert {"step_0", "step_1"} <= summary["names"]
+    fams = parse_prometheus(metrics_path.read_text())
+    assert fams["train_steps"]["samples"][("train_steps", ())] == 2.0
+    assert fams["train_step_ms"]["samples"][("train_step_ms_count", ())] == 2.0
+    assert "train_tokens_per_sec" in fams
+
+
 def test_inference_runner_serve_snapshot_crash_recovery(capsys, tmp_path):
     """ISSUE 5 CI gate, crash-recovery CLI contract: a run capped below
     drain leaves a snapshot file; re-invoking serve with the same

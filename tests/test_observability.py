@@ -1,0 +1,319 @@
+"""Observability-layer gates (ISSUE 6 tentpole).
+
+Four claims, each pinned against the serving engine rather than in
+isolation:
+
+* LIFECYCLE COVERAGE — a mixed chunked-prefill + overload + fault-injection
+  run exports valid Chrome trace-event JSON whose per-request lanes cover
+  every lifecycle state (queued span, chunk rounds, decode tokens, shed,
+  expiry, retire), checked by the schema validator the tier-1 CLI smoke
+  also runs.
+* STATS PARITY — the legacy ``engine.stats`` dict surface is now a view
+  over MetricsRegistry counters: every pre-existing key is present and
+  equals its backing counter on the same run, and the same values ride the
+  Prometheus exposition.
+* SINGLE SOURCE OF TRUTH — ``run_trace``'s ITL/stall percentiles (computed
+  from tracer token events) equal the legacy per-completion ``token_ts``
+  formula they replaced, on a reference trace.
+* ZERO PROGRAM IMPACT — tracing on vs off reuses the SAME compiled
+  programs (cache-key identity — instrumentation is invisible to XLA) and
+  produces bit-identical token streams.
+
+Tier-1 cost discipline: ONE module-scoped contiguous CausalLM (the sibling
+suites' tiny 2-layer config, block_steps=4) serves every test; registry/
+tracer units need no model at all.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+from neuronx_distributed_tpu.inference.engine import (
+    _STAT_KEYS,
+    run_trace,
+    synthetic_trace,
+)
+from neuronx_distributed_tpu.inference.faults import FaultPlan
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from neuronx_distributed_tpu.observability import (
+    MetricsRegistry,
+    Tracer,
+    parse_prometheus,
+    validate_chrome_trace,
+)
+
+TINY = dict(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, kv_size_multiplier=1, max_seq_len=64,
+    dtype=jnp.float32, use_flash_attention=False, remat_policy=None,
+)
+K = 4
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = LlamaConfig(**TINY)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(
+        LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0), ids))["params"]
+    return CausalLM(cfg, params, LlamaForCausalLM, buckets=(8, 16),
+                    max_batch=3).compile()
+
+
+def _prompts(n, s=8, seed=2):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n, s), 1, 127))
+
+
+# ------------------------------------------------- lifecycle + trace schema
+
+def test_mixed_run_exports_full_lifecycle_trace(lm, tmp_path):
+    """The acceptance gate: chunked prefill + overload (shed + queued
+    expiry) + injected dispatch faults in ONE traced run; the export loads
+    as valid Chrome trace JSON and the request lanes cover every lifecycle
+    state."""
+    eng = ServeEngine(
+        lm, block_steps=K, trace=True, prefill_chunk_tokens=4,
+        max_queue=1, rng=jax.random.key(7), dispatch_retries=6,
+        # seeded transient dispatch failures absorbed by retry (the seeded
+        # stream + fixed schedule make the fault pattern deterministic);
+        # streams stay bit-identical
+        faults=FaultPlan(dispatch_fail_prob=0.4, dispatch_max_failures=1,
+                         seed=3))
+    short = _prompts(2, s=4, seed=3)
+    long16 = _prompts(2, s=16, seed=5)
+    # EDF admits the deadline'd request first: it claims a slot, chunk-
+    # prefills 4 tokens/round, and its 2-block TTFT deadline dies MID-
+    # PREFILL (atomic abort + expire — no first token is ever sampled)
+    expiring = eng.submit(long16[0], 6, ttft_deadline_ms=2.0)
+    chunked = eng.submit(long16[1], 6)       # chunked: 16 tokens, C=4
+    inserted = eng.submit(short[0], 12)      # one-shot insert (4 <= C)
+    waiting = eng.submit(short[1], 12)       # queued until a slot frees
+    # arrived backlog == max_queue + free slots: the 5th submit is shed
+    shed = eng.submit(short[0], 4)
+    assert isinstance(expiring, int) and isinstance(waiting, int)
+    assert not isinstance(shed, int), "5th submit must be shed"
+    comps = eng.run()
+    assert any(c.expired and c.request_id == expiring for c in comps)
+    assert any(c.request_id == waiting and not c.expired for c in comps)
+    assert eng.stats["dispatch_retries"] > 0         # faults really fired
+
+    path = tmp_path / "serve_trace.json"
+    eng.tracer.export_chrome(str(path))
+    doc = json.loads(path.read_text())
+    summary = validate_chrome_trace(doc)
+    assert summary["events"] > 50
+    assert {"engine", "req"} <= set(summary["processes"])
+    # per-request lanes exist for every submitted id (shed victim included)
+    assert set(summary["request_lanes"]) >= {expiring, chunked, inserted,
+                                             waiting, shed.request_id}
+    required = {"submit", "queued", "admit", "first_token", "tok", "retire",
+                "chunk_begin", "prefill_chunk", "prefill_abort", "shed",
+                "expire", "decode_block", "fetch", "insert", "extend",
+                "decode", "fault:dispatch", "queue_depth"}
+    missing = required - summary["names"]
+    assert not missing, f"lifecycle states missing from trace: {missing}"
+    # the full chunked request's lane: 4 chunk rounds, retired at the end
+    tl = eng.request_timeline(chunked)
+    names = [e["name"] for e in tl]
+    assert names[0] == "submit" and "chunk_begin" in names
+    assert names.count("prefill_chunk") == 16 // 4
+    assert names[-1] == "retire"
+    ts = [e["ts_ms"] for e in tl]          # timeline is time-ordered
+    assert ts == sorted(ts)
+    # the expiring request's lane ends in expire, with NO first token
+    names_exp = [e["name"] for e in eng.request_timeline(expiring)]
+    assert names_exp[-1] == "expire" and "first_token" not in names_exp
+    assert "prefill_abort" in names_exp
+
+
+def test_request_timeline_empty_when_tracing_off(lm):
+    eng = ServeEngine(lm, block_steps=K)
+    eng.submit(_prompts(1)[0], 4)
+    eng.run()
+    assert eng.request_timeline(0) == []
+    assert eng.tracer.events() == []
+
+
+# ------------------------------------------------------------- stats parity
+
+def test_stats_parity_with_metrics_registry(lm):
+    """Satellite gate: every pre-existing ``engine.stats`` key still exists
+    and carries the value of its backing registry counter on an unchanged
+    reference trace — one store, two read surfaces."""
+    trace = synthetic_trace(5, 128, prompt_lens=(6, 8), max_new_tokens=6,
+                            mean_interarrival_blocks=0.7, seed=3)
+    eng = ServeEngine(lm, block_steps=K, trace=True)
+    report = run_trace(eng, trace)
+    assert report["requests_completed"] == 5
+    # the full legacy key set survives, dict-style access included
+    assert set(_STAT_KEYS) <= set(eng.stats.keys())
+    legacy = dict(eng.stats)
+    assert legacy["inserted_requests"] == 5
+    assert legacy["program_calls"] == legacy["host_fetches"] \
+        == legacy["decode_blocks"]
+    for k in _STAT_KEYS:
+        assert eng.stats[k] == eng.metrics.counter("serve_" + k).value, k
+    # ad-hoc keys keep working through the view (setdefault path)
+    eng.stats.setdefault("ad_hoc", 0)
+    eng.stats["ad_hoc"] += 3
+    assert eng.stats["ad_hoc"] == 3 \
+        and eng.metrics.counter("serve_ad_hoc").value == 3
+    # and the exposition carries the same numbers
+    fams = parse_prometheus(eng.metrics.to_prometheus())
+    assert fams["serve_inserted_requests"]["samples"][
+        ("serve_inserted_requests", ())] == 5.0
+    assert "serve_dispatch_ms" in fams and "serve_ttft_ms" in fams
+    assert "compile_ms" in fams     # compile-vs-execute split present
+
+
+# ------------------------------------- run_trace percentiles: old == new
+
+def test_itl_percentiles_match_legacy_token_ts_path(lm):
+    """The run_trace fix's parity gate: ITL/stall percentiles computed from
+    tracer token events must equal the legacy per-completion ``token_ts``
+    formula (np.diff > 0 filter) they replaced, on a reference trace."""
+    trace = synthetic_trace(6, 128, prompt_lens=(6, 8, 12),
+                            max_new_tokens=8, mean_interarrival_blocks=0.5,
+                            seed=11)
+    eng = ServeEngine(lm, block_steps=K, trace=True)
+    report = run_trace(eng, trace)
+    completions = eng.completed
+    gaps = []
+    legacy_per_req = {}
+    for c in completions:
+        g = (np.diff(c.token_ts) * 1e3
+             if c.token_ts is not None and len(c.token_ts) > 1
+             else np.zeros((0,)))
+        g = g[g > 0.0]
+        gaps.extend(g.tolist())
+        legacy_per_req[c.request_id] = (
+            round(float(g.max()), 2) if g.size else 0.0)
+    assert gaps, "reference trace produced no delivery gaps"
+    assert report["itl_p50_ms"] == pytest.approx(
+        round(float(np.percentile(gaps, 50)), 3))
+    assert report["itl_p99_ms"] == pytest.approx(
+        round(float(np.percentile(gaps, 99)), 3))
+    assert report["max_itl_gap_ms"] == pytest.approx(
+        round(float(np.max(gaps)), 2))
+    for pr in report["per_request"]:
+        assert pr["max_itl_gap_ms"] == pytest.approx(
+            legacy_per_req[pr["request_id"]]), pr["request_id"]
+
+
+# ---------------------------------------- tracing cannot touch programs
+
+def test_programs_identical_and_streams_bitwise_traced_vs_untraced(lm):
+    """Tracing on vs off: the fused session program comes from the SAME
+    cache entry (key set unchanged, executable identity — nothing about
+    instrumentation reaches XLA) and token streams are bit-identical."""
+    p = _prompts(3, seed=9)
+    submits = [dict(prompt=p[0], max_new_tokens=8),
+               dict(prompt=p[1], max_new_tokens=6, arrival_block=1),
+               dict(prompt=p[2], max_new_tokens=7, arrival_block=2)]
+    keys_before = set(lm._session_fused)
+    compile_before = dict(lm.compile_ms)
+    results = {}
+    for trace in (True, False):
+        eng = ServeEngine(lm, block_steps=K, trace=trace,
+                          rng=jax.random.key(42))
+        ids = [eng.submit(**kw) for kw in submits]
+        comps = {c.request_id: c for c in eng.run()}
+        results[trace] = {r: comps[r].tokens.tolist() for r in ids}
+    assert results[True] == results[False]
+    # no new program compiled for either mode, byte-identical by identity:
+    # both engines hit the one cached executable (or, had none existed yet,
+    # exactly one was compiled and then shared)
+    assert set(lm._session_fused) == keys_before or \
+        len(lm._session_fused) == len(keys_before) + 1
+    assert len({id(v) for v in lm._session_fused.values()}) \
+        == len(lm._session_fused)
+    # compile timings recorded once per signature, never re-triggered by
+    # toggling tracing
+    for sig, ms in compile_before.items():
+        assert lm.compile_ms[sig] == ms, sig
+
+
+# --------------------------------------------------- registry / tracer units
+
+def test_metrics_registry_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("reqs_total", help="requests").inc(41)
+    reg.counter("reqs_total").inc()
+    g = reg.gauge("depth", help="queue depth")
+    g.set(7)
+    g.set(3)
+    h = reg.histogram("lat_ms", lo=1.0, growth=2.0, n_buckets=8)
+    for v in (0.5, 1.5, 3.0, 100.0, 1e9):
+        h.observe(v)
+    labeled = reg.counter("dispatch_total", kind="insert")
+    labeled.inc(5)
+    text = reg.to_prometheus()
+    fams = parse_prometheus(text)
+    assert fams["reqs_total"]["type"] == "counter"
+    assert fams["reqs_total"]["samples"][("reqs_total", ())] == 42.0
+    # gauge carries the last value AND the peak
+    assert fams["depth"]["samples"][("depth", ())] == 3.0
+    assert fams["depth"]["samples"][("depth_max", ())] == 7.0
+    assert fams["dispatch_total"]["samples"][
+        ("dispatch_total", (("kind", "insert"),))] == 5.0
+    # histogram: cumulative buckets end at +Inf == count, sum preserved
+    hs = fams["lat_ms"]["samples"]
+    assert hs[("lat_ms_count", ())] == 5.0
+    assert hs[("lat_ms_sum", ())] == pytest.approx(1e9 + 105.0)
+    inf_key = [k for k in hs if k[0] == "lat_ms_bucket"
+               and ("le", "+Inf") in k[1]]
+    assert len(inf_key) == 1 and hs[inf_key[0]] == 5.0
+    # quantile edges are honest overestimates (log-bucket upper edge)
+    assert h.percentile(50) >= 3.0
+    # one name cannot be two kinds
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("reqs_total")
+
+
+def test_tracer_ring_buffer_and_disabled_cost():
+    tr = Tracer(capacity=8)
+    for i in range(20):
+        tr.instant(f"e{i}", ("engine", "t"))
+    assert len(tr.events()) == 8 and tr.dropped == 12
+    doc = tr.export_chrome()
+    assert doc["otherData"]["dropped_events"] == 12
+    validate_chrome_trace(doc, require_request_lanes=False)
+    off = Tracer(enabled=False)
+    off.instant("x", ("engine", "t"))
+    with off.span("s", ("engine", "t")):
+        pass
+    assert off.events() == [] and off.dropped == 0
+    # a span whose body raises still records, marked with the error
+    tr2 = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr2.span("boom", ("engine", "t")):
+            raise RuntimeError("x")
+    ev = tr2.events("boom")[0]
+    assert ev["args"]["error"] == "RuntimeError"
+
+
+def test_validate_chrome_trace_rejects_malformed():
+    with pytest.raises(ValueError, match="traceEvents"):
+        validate_chrome_trace({"foo": 1})
+    good = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "engine"}},
+        {"name": "a", "ph": "i", "pid": 1, "tid": 0, "ts": 2.0},
+    ]}
+    validate_chrome_trace(good, require_request_lanes=False)
+    bad_order = {"traceEvents": good["traceEvents"] + [
+        {"name": "b", "ph": "i", "pid": 1, "tid": 0, "ts": 1.0}]}
+    with pytest.raises(ValueError, match="out of order"):
+        validate_chrome_trace(bad_order, require_request_lanes=False)
+    bad_dur = {"traceEvents": [
+        {"name": "x", "ph": "X", "pid": 1, "tid": 0, "ts": 0.0}]}
+    with pytest.raises(ValueError, match="dur"):
+        validate_chrome_trace(bad_dur, require_request_lanes=False)
+    with pytest.raises(ValueError, match="request lanes"):
+        validate_chrome_trace(good)

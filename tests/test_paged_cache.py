@@ -308,25 +308,27 @@ def test_paged_run_trace_reports_paged_surface(stack):
 
 def test_paged_chunked_dispatch_contract(stack):
     """Chunked admission on the PAGED engine keeps the decode half's
-    <= 2-host-ops-per-block contract — counted with the shared
-    tests/helpers.py counters (the inline wrappers this file and
-    test_serving_engine.py used to re-implement), while chunk extends ride
-    their own accounting."""
-    from tests.helpers import count_factory_calls
+    <= 2-host-ops-per-block contract — counted from the engine TRACER's
+    dispatch spans (tests/helpers.py; the run therefore also proves the
+    contract holds with tracing ON), while chunk extends ride their own
+    accounting: exactly one 'extend' dispatch per chunk."""
+    from tests.helpers import decode_host_ops_per_block, dispatch_counts
 
     cfg, params, lm_c, lm_p = stack
     p = _prompts(2, seed=17)
     long16 = _prompts(1, s=16, seed=19)[0]
-    with count_factory_calls(lm_p, "compile_session_decode_fused") as calls:
-        eng = ServeEngine(lm_p, block_steps=K, prefill_chunk_tokens=8,
-                          rng=jax.random.key(11))
-        eng.submit(p[0], 8)
-        eng.submit(long16, 5, arrival_block=1)
-        comps = eng.run()
+    eng = ServeEngine(lm_p, block_steps=K, prefill_chunk_tokens=8,
+                      rng=jax.random.key(11), trace=True)
+    eng.submit(p[0], 8)
+    eng.submit(long16, 5, arrival_block=1)
+    comps = eng.run()
     assert len(comps) == 2
-    assert calls.n == eng.stats["decode_blocks"] >= 2
-    assert eng.stats["program_calls"] == eng.stats["host_fetches"] == calls.n
-    assert eng.stats["chunk_program_calls"] == 16 // 8
+    counts = dispatch_counts(eng)
+    assert counts["decode"] == eng.stats["decode_blocks"] >= 2
+    assert eng.stats["program_calls"] == eng.stats["host_fetches"] \
+        == counts["decode"] == counts["fetch"]
+    assert decode_host_ops_per_block(eng) == 2.0
+    assert eng.stats["chunk_program_calls"] == counts["extend"] == 16 // 8
     # the chunked request's stream still equals its solo oracle
     g = lm_c.generate(long16[None], max_new_tokens=5)
     by_id = {c.request_id: c for c in comps}

@@ -50,8 +50,8 @@ def _prompts(n, s=8, seed=2):
     return np.asarray(jax.random.randint(jax.random.PRNGKey(seed), (n, s), 1, 127))
 
 
-def _run_engine(lm_, fused, submits, rng_seed=42, **eng_kw):
-    eng = ServeEngine(lm_, block_steps=K, fused=fused,
+def _run_engine(lm_, fused, submits, rng_seed=42, trace=False, **eng_kw):
+    eng = ServeEngine(lm_, block_steps=K, fused=fused, trace=trace,
                       rng=jax.random.key(rng_seed), **eng_kw)
     ids = [eng.submit(**kw) for kw in submits]
     comps = {c.request_id: c for c in eng.run()}
@@ -120,18 +120,29 @@ def test_session_eos_retires_and_slot_is_reused(lm):
 
 
 def test_session_fused_dispatch_count(lm):
-    """The dispatch contract, independently counted: ONE compiled-program
-    invocation per K-token block (plus the single fetch — <= 2 host ops),
-    matching the engine's self-reported stats."""
-    from tests.helpers import count_factory_calls
+    """The dispatch contract, counted three independent ways ON THE SAME
+    RUN — tracer dispatch spans (the observability surface), a monkeypatch
+    wrapper around the compiled program (the tracer-independent
+    cross-check), and the engine's own stats — all agreeing at ONE program
+    invocation + ONE fetch per K-token block. Runs with tracing ENABLED,
+    which is itself the tentpole's proof that instrumentation does not add
+    host ops."""
+    from tests.helpers import (
+        count_factory_calls, decode_host_ops_per_block, dispatch_counts,
+    )
 
     p = _prompts(2, seed=9)
     with count_factory_calls(lm, "compile_session_decode_fused") as calls:
         eng, ids, comps = _run_engine(
             lm, True, [dict(prompt=p[0], max_new_tokens=10),
-                       dict(prompt=p[1], max_new_tokens=7, arrival_block=1)])
+                       dict(prompt=p[1], max_new_tokens=7, arrival_block=1)],
+            trace=True)
     assert calls.n == eng.stats["decode_blocks"] >= 2
     assert eng.stats["program_calls"] == eng.stats["host_fetches"] == calls.n
+    # tracer-counted: decode dispatches == monkeypatch-counted program
+    # invocations, and decode + fetch == 2 host ops per block exactly
+    assert dispatch_counts(eng, "decode") == calls.n
+    assert decode_host_ops_per_block(eng) == 2.0
     rep_ops = (eng.stats["program_calls"] + eng.stats["host_fetches"]) \
         / eng.stats["decode_blocks"]
     assert rep_ops == 2.0

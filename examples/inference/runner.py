@@ -399,6 +399,10 @@ def cmd_serve(args) -> None:
     )
     from neuronx_distributed_tpu.inference.faults import resolve_fault_plan
 
+    from neuronx_distributed_tpu.inference.router import (
+        Router, run_router_trace,
+    )
+
     lm, cfg = build_model(args)
     lm.compile()
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
@@ -431,8 +435,6 @@ def cmd_serve(args) -> None:
                                               for c in completions)),
         }))
         return
-    engine = ServeEngine(lm, rng=jax.random.key(args.seed),
-                         faults=resolve_fault_plan(args.fault_plan), **eng_kw)
     prompt_lens = ((8, 12, 16) if args.tiny
                    else (64, min(128, args.prompt_len), args.prompt_len))
     trace = synthetic_trace(
@@ -444,8 +446,36 @@ def cmd_serve(args) -> None:
         long_prompt_len=args.long_prompt_len,
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,
+        tenants=args.tenants,
+        tenant_skew=args.tenant_skew,
         seed=args.seed,
     )
+    if args.replicas > 1:
+        # multi-replica front door: N ServeEngine replicas (one shared lm,
+        # N sessions) behind the Router — prefix-affinity placement,
+        # per-tenant WFQ, heartbeat failover, graceful drain.
+        # --crash_replica_at B injects one replica crash (the last
+        # replica) at router block B: the CI smoke's failover gate.
+        crash_at = ([(args.crash_replica_at, args.replicas - 1)]
+                    if args.crash_replica_at is not None else ())
+        router = Router(lm, args.replicas, rng=jax.random.key(args.seed),
+                        crash_at=crash_at,
+                        faults=resolve_fault_plan(args.fault_plan),
+                        **eng_kw)
+        report = run_router_trace(router, trace)
+        if args.trace_out:
+            router.tracer.export_chrome(args.trace_out)
+        if args.metrics_out:
+            router.metrics.dump(args.metrics_out)
+        report.update({
+            "model": args.model + ("_tiny" if args.tiny else ""),
+            "max_batch": lm.max_batch,
+            "num_requests": args.num_requests,
+        })
+        print(json.dumps(report))
+        return
+    engine = ServeEngine(lm, rng=jax.random.key(args.seed),
+                         faults=resolve_fault_plan(args.fault_plan), **eng_kw)
     # warm every program the trace will hit (all insert widths per bucket +
     # the fused block) OUTSIDE the timed window — cmd_generate's discipline.
     # Paged mode compiles its insert programs lazily per suffix width; the
@@ -666,6 +696,23 @@ def main(argv=None) -> None:
                             "drain; if it EXISTS at startup the previous "
                             "run's in-flight streams are restored and "
                             "finished bit-identical")
+        p.add_argument("--replicas", type=int, default=1,
+                       help="serve: N>1 drives N ServeEngine replicas "
+                            "behind the Router front door (prefix-affinity "
+                            "placement, per-tenant WFQ, heartbeat failover, "
+                            "graceful drain) over one shared model")
+        p.add_argument("--tenants", type=int, default=0,
+                       help="serve: label trace requests with this many "
+                            "tenants, Zipf-skewed (t0 is the heavy hitter); "
+                            "the report grows a per-tenant table")
+        p.add_argument("--tenant_skew", type=float, default=1.0,
+                       help="serve --tenants: Zipf exponent of the tenant "
+                            "distribution (0 = uniform)")
+        p.add_argument("--crash_replica_at", type=int, default=None,
+                       help="serve --replicas: crash the last replica at "
+                            "this router block — its streams fail over to "
+                            "the survivors bit-identical (the CI smoke "
+                            "asserts the report's failover counters)")
         p.add_argument("--trace_out", type=str, default=None,
                        help="serve: write the engine's per-request timeline "
                             "(Chrome trace-event JSON, loadable in "

@@ -18,6 +18,11 @@ from neuronx_distributed_tpu.inference.faults import (  # noqa: F401
     FaultPlan,
     TransientDispatchError,
 )
+from neuronx_distributed_tpu.inference.router import (  # noqa: F401
+    NoLiveReplicas,
+    Router,
+    run_router_trace,
+)
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
 from neuronx_distributed_tpu.inference.paged_cache import (  # noqa: F401
     PageAllocator,

@@ -90,7 +90,7 @@ import os
 import time
 from collections import deque
 from collections.abc import MutableMapping
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -132,6 +132,9 @@ class Request:
     # by ttft_deadline_block, the whole stream by deadline_block
     ttft_deadline_block: Optional[int] = None
     deadline_block: Optional[int] = None
+    # multi-tenant isolation label (the Router's fairness/quota unit; a
+    # bare engine just carries it through to the completion)
+    tenant: str = "default"
 
 
 @dataclasses.dataclass
@@ -154,6 +157,7 @@ class Completion:
     # ``deadline_missed`` also covers requests that finished late
     expired: bool = False
     deadline_missed: bool = False
+    tenant: str = "default"
 
 
 @dataclasses.dataclass
@@ -280,6 +284,7 @@ class ServeEngine:
         trace: bool = False,
         tracer: Optional[Tracer] = None,
         metrics: Optional[MetricsRegistry] = None,
+        name: Optional[str] = None,
     ):
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
@@ -318,6 +323,10 @@ class ServeEngine:
         self.block_time_ms = float(block_time_ms)
         self.dispatch_retries = int(dispatch_retries)
         self.dispatch_backoff_s = float(dispatch_backoff_s)
+        # tracer lane process group: a bare engine records on ("engine", x);
+        # a Router names each replica ("replica<i>") so one shared tracer
+        # renders per-replica timelines side by side in Perfetto
+        self.lane = str(name) if name else "engine"
         self._injector: Optional[FaultInjector] = None
         if faults is not None:
             self._injector = (faults if isinstance(faults, FaultInjector)
@@ -396,25 +405,13 @@ class ServeEngine:
 
     # --- submission ------------------------------------------------------
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int,
-               sampler: Optional[Sampler] = None,
-               eos_token_id: Optional[int] = None,
-               arrival_block: int = 0,
-               ttft_deadline_ms: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> Union[int, "Rejected"]:
-        """Queue a request; returns its id — or, when the bounded queue
-        sheds it at arrival, a structured :class:`Rejected` with a
-        retry-after estimate. The per-request ``sampler`` must agree with
-        the engine's static ``top_k``/``top_p`` (those are baked into the
-        compiled program — a mismatch would silently sample a different
-        distribution, so it is rejected here at admission).
-
-        ``ttft_deadline_ms``/``deadline_ms`` are budgets RELATIVE TO ARRIVAL
-        for the first token and the whole stream, converted to the virtual
-        block clock at ``block_time_ms`` per block. A queued or mid-prefill
-        request whose deadline passes is expired without burning prefill; a
-        decoding request past ``deadline_ms`` retires at the next block
-        boundary with a partial ``expired=True`` completion."""
+    def _validate_submit(self, prompt: np.ndarray, max_new_tokens: int,
+                         sampler: Optional[Sampler]
+                         ) -> Tuple[np.ndarray, Sampler, bool]:
+        """Shared admission validation (used by :meth:`submit` and the
+        Router, which builds its own :class:`Request`): prompt shape, cache
+        room, bucket/chunk ceiling, pool feasibility, sampler compatibility.
+        Returns the normalized (prompt, sampler, greedy) triple."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -451,8 +448,39 @@ class ServeEngine:
                 f"differ from the engine's compiled "
                 f"{self.slot_sampler.top_k}/{self.slot_sampler.top_p}")
         greedy = bool(sampler.greedy or sampler.temperature == 0.0)
+        return prompt, sampler, greedy
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               sampler: Optional[Sampler] = None,
+               eos_token_id: Optional[int] = None,
+               arrival_block: int = 0,
+               ttft_deadline_ms: Optional[float] = None,
+               deadline_ms: Optional[float] = None,
+               tenant: str = "default",
+               request_id: Optional[int] = None) -> Union[int, "Rejected"]:
+        """Queue a request; returns its id — or, when the bounded queue
+        sheds it at arrival, a structured :class:`Rejected` with a
+        retry-after estimate. The per-request ``sampler`` must agree with
+        the engine's static ``top_k``/``top_p`` (those are baked into the
+        compiled program — a mismatch would silently sample a different
+        distribution, so it is rejected here at admission).
+
+        ``ttft_deadline_ms``/``deadline_ms`` are budgets RELATIVE TO ARRIVAL
+        for the first token and the whole stream, converted to the virtual
+        block clock at ``block_time_ms`` per block. A queued or mid-prefill
+        request whose deadline passes is expired without burning prefill; a
+        decoding request past ``deadline_ms`` retires at the next block
+        boundary with a partial ``expired=True`` completion.
+
+        ``request_id`` pins an external id (the Router's globally-unique
+        ids) instead of the engine's own counter: the per-request rng
+        contract keys streams on the id, so a request replayed on another
+        replica under the same id is bit-identical wherever it runs."""
+        prompt, sampler, greedy = self._validate_submit(
+            prompt, max_new_tokens, sampler)
+        rid = self._next_id if request_id is None else int(request_id)
         req = Request(
-            request_id=self._next_id, prompt=prompt,
+            request_id=rid, prompt=prompt,
             max_new_tokens=int(max_new_tokens), eos_token_id=eos_token_id,
             temperature=0.0 if greedy else float(sampler.temperature),
             greedy=greedy, arrival_block=int(arrival_block),
@@ -461,29 +489,46 @@ class ServeEngine:
                 arrival_block, ttft_deadline_ms, "ttft_deadline_ms"),
             deadline_block=self._deadline_block(
                 arrival_block, deadline_ms, "deadline_ms"),
+            tenant=str(tenant),
         )
-        self._next_id += 1
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> Union[int, "Rejected"]:
+        """Queue an already-validated :class:`Request` (the Router's
+        placement path — deadlines arrive as ABSOLUTE blocks on the shared
+        clock, so a router-queued wait never silently extends a budget)."""
+        self._next_id = max(self._next_id, req.request_id + 1)
         now = time.perf_counter()
         self._submit_ts[req.request_id] = now
         if self.tracer.enabled:
             self.tracer.instant(
                 "submit", ("req", req.request_id), block=self.blocks,
                 ts=now,
-                args={"prompt_len": int(prompt.size),
-                      "max_new_tokens": int(max_new_tokens),
-                      "arrival_block": int(arrival_block),
+                args={"prompt_len": int(req.prompt.size),
+                      "max_new_tokens": int(req.max_new_tokens),
+                      "arrival_block": int(req.arrival_block),
                       "ttft_deadline_block": req.ttft_deadline_block,
-                      "deadline_block": req.deadline_block})
+                      "deadline_block": req.deadline_block,
+                      "tenant": req.tenant,
+                      "engine": self.lane})
         # bound the ARRIVED backlog at submit time (the live-client path);
         # future-arrival submissions are scheduled arrivals, not queue
         # pressure — they are shed at the block boundary where they arrive
         # into an already-full queue (_shed_overflow). Free slots extend the
-        # limit: a request the next round admits immediately is not backlog.
+        # limit (a request the next round admits immediately is not
+        # backlog) — but only slots the PAGE POOL could actually fill: under
+        # pool exhaustion a free slot admits nothing, so it must not excuse
+        # unbounded queueing (the rejection then says so, with a retry-after
+        # read off the oldest decoding stream's remaining budget — the
+        # earliest retirement that returns pages).
         if self.max_queue is not None and req.arrival_block <= self.blocks:
             arrived = sum(1 for r in self.queue
                           if r.arrival_block <= self.blocks)
-            if arrived >= self.max_queue + len(self._free_slots()):
-                return self._shed(req)
+            pool_bound = not self._pool_can_admit(req.prompt.size,
+                                                  req.max_new_tokens)
+            usable = 0 if pool_bound else len(self._free_slots())
+            if arrived >= self.max_queue + usable:
+                return self._shed(req, pool_bound=pool_bound)
         self.queue.append(req)
         self._m_queue.set(len(self.queue))
         return req.request_id
@@ -597,11 +642,48 @@ class ServeEngine:
         rate = max(self.lm.max_batch * self.block_steps, 1)
         return max(1, -(-(queued + inflight) // rate))
 
-    def _shed(self, req: Request) -> Union[int, Rejected]:
+    def _pool_can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether the page pool could cover this admission RIGHT NOW
+        (free pages plus whatever LRU eviction of cache-only prefix pages
+        would return). Contiguous engines always can — their slots ARE the
+        capacity."""
+        if not self.paged:
+            return True
+        pkv = self.session.paged
+        need = pkv.pages_needed(prompt_len,
+                                max_new_tokens + self.block_steps)
+        free = pkv.allocator.available()
+        if free < need and pkv.prefix is not None:
+            free += pkv.prefix.evictable_pages()
+        return free >= need
+
+    def _pool_retry_after(self) -> int:
+        """Pool-pressure retry estimate: the OLDEST decoding request's
+        remaining token budget in blocks — the earliest retirement that
+        returns pages to the pool (a shed client resubmitting after that
+        many blocks meets a drained-enough pool)."""
+        oldest: Optional[Request] = None
+        for slot, req in enumerate(self.slots):
+            if req is None or slot in self._prefilling:
+                continue
+            if oldest is None or ((req.start_block or 0)
+                                  < (oldest.start_block or 0)):
+                oldest = req
+        if oldest is None:
+            return 1
+        remaining = (oldest.max_new_tokens
+                     - len(self._out.get(oldest.request_id, [])))
+        return max(1, -(-remaining // self.block_steps))
+
+    def _shed(self, req: Request,
+              pool_bound: bool = False) -> Union[int, Rejected]:
         """Shed on an over-full arrived backlog: 'tail' rejects the
         newcomer; 'deadline' rejects whichever of queue+newcomer has the
         laxest deadline (the newcomer may displace a queued request, which
-        then surfaces in ``self.rejected``)."""
+        then surfaces in ``self.rejected``). ``pool_bound`` marks a shed
+        forced by page-pool exhaustion rather than queue depth: the reason
+        says so and the retry-after is read off the oldest decoding
+        stream's remaining budget instead of the queue-drain rate."""
         victim = req
         if self.shed_policy == "deadline":
             arrived = [r for r in self.queue
@@ -612,16 +694,22 @@ class ServeEngine:
                 self.queue.append(req)
                 victim = worst
                 self.stats["shed_evictions"] += 1
+        retry = self._retry_after()
+        if pool_bound:
+            retry = max(retry, self._pool_retry_after())
         rej = Rejected(request_id=victim.request_id,
-                       retry_after_blocks=self._retry_after(),
+                       retry_after_blocks=retry,
                        queue_depth=sum(1 for r in self.queue
-                                       if r.arrival_block <= self.blocks))
+                                       if r.arrival_block <= self.blocks),
+                       reason="pool_exhausted" if pool_bound
+                       else "queue_full")
         self.rejected.append(rej)
         self.stats["rejected"] += 1
         if self.tracer.enabled:
             self.tracer.instant(
                 "shed", ("req", victim.request_id), block=self.blocks,
                 args={"policy": self.shed_policy,
+                      "reason": rej.reason,
                       "retry_after_blocks": rej.retry_after_blocks,
                       "queue_depth": rej.queue_depth})
         return rej if victim is req else req.request_id
@@ -686,7 +774,7 @@ class ServeEngine:
                 hist.observe((t1 - t0) * 1e3)
                 if self.tracer.enabled:
                     self.tracer.complete(
-                        kind, ("engine", "dispatch"), t0, t1,
+                        kind, (self.lane, "dispatch"), t0, t1,
                         block=self.blocks,
                         args={"retries": attempts} if attempts else None)
                 return out
@@ -695,7 +783,7 @@ class ServeEngine:
                 self.stats["dispatch_retries"] += 1
                 if self.tracer.enabled:
                     self.tracer.instant(
-                        "fault:dispatch", ("engine", "faults"),
+                        "fault:dispatch", (self.lane, "faults"),
                         block=self.blocks,
                         args={"kind": kind, "attempt": attempts,
                               "error": str(e)})
@@ -733,6 +821,7 @@ class ServeEngine:
             token_ts=np.asarray(ts, np.float64),
             cancelled=cancelled, expired=expired,
             deadline_missed=expired or self._missed(req),
+            tenant=req.tenant,
         )
 
     def _complete_slot(self, slot: int, cancelled: bool = False,
@@ -795,6 +884,7 @@ class ServeEngine:
             ttft_blocks=max(self.blocks - req.arrival_block, 0),
             token_ts=np.zeros((0,), np.float64),
             expired=True, deadline_missed=True,
+            tenant=req.tenant,
         ))
         self.stats["expired"] += 1
 
@@ -1222,7 +1312,7 @@ class ServeEngine:
         bad = {int(p) for p in pages}
         if self.tracer.enabled:
             self.tracer.instant(
-                "fault:corrupt_pages", ("engine", "faults"),
+                "fault:corrupt_pages", (self.lane, "faults"),
                 block=self.blocks,
                 args={"pages": sorted(bad)})
         self._corrupt_page_bytes(sorted(bad))
@@ -1252,6 +1342,57 @@ class ServeEngine:
                     args={"delivered": len(pregen)})
         self._drain_replays()
 
+    # --- router hooks: resume, drain extraction --------------------------
+    # The Router's failover/drain machinery moves whole requests between
+    # replicas. Nothing here invents new recovery mechanics — it re-exposes
+    # the replay/abort primitives the snapshot and corruption paths already
+    # use, as public seams.
+
+    def resume(self, req: Request, generated: Sequence[int] = ()) -> int:
+        """Enqueue a recovery replay of ``req``: its KV is rebuilt from
+        (prompt + ``generated``) at the next block boundary and the stream
+        resumes at token index ``len(generated)`` — bit-identical to an
+        uninterrupted run, per the per-request rng contract. The Router's
+        failover path (replica died mid-stream) and any external recovery
+        record land here."""
+        self._next_id = max(self._next_id, req.request_id + 1)
+        req.start_block = None
+        req.first_token_block = None
+        self._replay_q.append((req, [int(t) for t in generated], []))
+        return req.request_id
+
+    def extract_queued(self) -> List[Request]:
+        """Remove and return every queued (not yet admitted) request — the
+        drain path's migration source. No completions are recorded; the
+        caller re-places the requests elsewhere."""
+        out = list(self.queue)
+        self.queue.clear()
+        self._m_queue.set(0)
+        return out
+
+    def extract_prefilling(self) -> List[Request]:
+        """Abort every in-flight chunked admission (atomic page rollback —
+        the cancel machinery) and return the requests for re-placement.
+        Spent chunk work is discarded; correctness never depends on it."""
+        out = []
+        for slot in list(self._prefilling):
+            out.append(self._prefilling[slot].req)
+            self._abort_prefill(slot, requeue=False)
+        return out
+
+    def extract_replays(self) -> List[Tuple[Request, List[int]]]:
+        """Remove and return pending recovery replays as (request,
+        generated-so-far) pairs — drained replicas hand them to peers."""
+        out = [(req, list(gen)) for req, gen, _ts in self._replay_q]
+        self._replay_q.clear()
+        return out
+
+    def has_decode_work(self) -> bool:
+        """True while any slot still runs (decoding or mid-prefill) or a
+        recovery replay is pending — the Router's drain-completion gate."""
+        return (bool(self._replay_q) or bool(self._prefilling)
+                or any(r is not None for r in self.slots))
+
     # --- snapshot / restore ------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -1275,6 +1416,7 @@ class ServeEngine:
                 "deadline_block": r.deadline_block,
                 "generated": [int(t) for t in generated],
                 "state": state,
+                "tenant": r.tenant,
             }
 
         reqs = []
@@ -1318,7 +1460,7 @@ class ServeEngine:
         """Crash-safe snapshot write (tmp + atomic rename): a reader never
         sees a half-written file, so a crash DURING the snapshot leaves the
         previous one intact."""
-        with self.tracer.span("snapshot_save", ("engine", "snapshot"),
+        with self.tracer.span("snapshot_save", (self.lane, "snapshot"),
                               block=self.blocks):
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
@@ -1360,6 +1502,7 @@ class ServeEngine:
                 submit_block=eng.blocks,
                 ttft_deadline_block=rd.get("ttft_deadline_block"),
                 deadline_block=rd.get("deadline_block"),
+                tenant=rd.get("tenant", "default"),
             )
             if rd["state"] == "decoding":
                 eng._replay_q.append(
@@ -1371,7 +1514,7 @@ class ServeEngine:
             eng.stats["restored_requests"] += 1
         if eng.tracer.enabled:
             eng.tracer.instant(
-                "restore", ("engine", "snapshot"), block=eng.blocks,
+                "restore", (eng.lane, "snapshot"), block=eng.blocks,
                 args={"requests": len(snap["requests"])})
         eng._drain_replays()
         return eng
@@ -1421,7 +1564,7 @@ class ServeEngine:
         self._m_queue.set(depth)
         tr_on = self.tracer.enabled
         if tr_on:
-            self.tracer.counter("queue_depth", ("engine", "queue"), depth,
+            self.tracer.counter("queue_depth", (self.lane, "queue"), depth,
                                 block=self.blocks)
         if self.paged and self.session.paged is not None:
             in_use = self.session.paged.allocator.in_use()
@@ -1438,7 +1581,7 @@ class ServeEngine:
             return np.asarray(arr)
         t0 = time.perf_counter()
         out = np.asarray(arr)
-        self.tracer.complete("fetch", ("engine", "dispatch"), t0,
+        self.tracer.complete("fetch", (self.lane, "dispatch"), t0,
                              time.perf_counter(), block=self.blocks)
         return out
 
@@ -1475,7 +1618,7 @@ class ServeEngine:
         now = time.perf_counter()
         if self.tracer.enabled:
             self.tracer.complete(
-                "decode_block", ("engine", "blocks"), t0, now,
+                "decode_block", (self.lane, "blocks"), t0, now,
                 block=self.blocks,
                 args={"active": int(self._active.sum()),
                       "steps": self.block_steps, "fused": self.fused})
@@ -1619,6 +1762,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     long_prompt_len: int = 0,
                     ttft_deadline_ms: Optional[float] = None,
                     deadline_ms: Optional[float] = None,
+                    tenants: int = 0,
+                    tenant_skew: float = 1.0,
                     seed: int = 0) -> List[dict]:
     """Deterministic synthetic arrival trace (virtual time in blocks):
     exponential inter-arrivals, prompt lengths cycled through
@@ -1632,14 +1777,29 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     tailed: every ``round(1/frac)``-th request (never the first, so decode
     traffic is already live when the first long prompt arrives) carries a
     ``long_prompt_len``-token prompt instead — the prefill/decode
-    interference workload ``prefill_chunk_tokens`` exists for."""
+    interference workload ``prefill_chunk_tokens`` exists for.
+
+    ``tenants > 0`` labels each request with a tenant drawn from a
+    Zipf-skewed distribution over ``t0..t<tenants-1>`` (P(rank k) ∝
+    1/(k+1)^tenant_skew — t0 is the heavy hitter; skew 0 is uniform): the
+    multi-tenant burst workload the Router's weighted fair queueing and
+    tenant-aware shedding exist for. ``run_trace``/``run_router_trace``
+    then report the per-tenant latency/goodput surface."""
     if long_prompt_frac < 0 or long_prompt_frac > 1:
         raise ValueError(f"long_prompt_frac must be in [0, 1], got {long_prompt_frac}")
     if long_prompt_frac > 0 and long_prompt_len < 1:
         raise ValueError("long_prompt_frac > 0 needs long_prompt_len >= 1")
+    if tenants < 0:
+        raise ValueError(f"tenants must be >= 0, got {tenants}")
+    if tenant_skew < 0:
+        raise ValueError(f"tenant_skew must be >= 0, got {tenant_skew}")
     long_every = round(1 / long_prompt_frac) if long_prompt_frac > 0 else 0
     rs = np.random.RandomState(seed)
     prefix = rs.randint(1, vocab_size, (shared_prefix_len,)).astype(np.int32)
+    tenant_p = None
+    if tenants:
+        w = 1.0 / np.arange(1, tenants + 1, dtype=np.float64) ** tenant_skew
+        tenant_p = w / w.sum()
     t = 0.0
     trace = []
     for i in range(num_requests):
@@ -1648,6 +1808,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         if long_every and i % long_every == long_every - 1:
             s = int(long_prompt_len)
         tail = rs.randint(1, vocab_size, (s,)).astype(np.int32)
+        if tenant_p is not None:
+            trace_tenant = f"t{int(rs.choice(tenants, p=tenant_p))}"
         trace.append({
             "prompt": np.concatenate([prefix, tail]) if shared_prefix_len else tail,
             "max_new_tokens": max_new_tokens,
@@ -1658,7 +1820,49 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
             "ttft_deadline_ms": ttft_deadline_ms,
             "deadline_ms": deadline_ms,
         })
+        if tenant_p is not None:
+            trace[-1]["tenant"] = trace_tenant
     return trace
+
+
+def per_tenant_report(completions: List[Completion],
+                      tok_ts: Dict[int, np.ndarray], wall_s: float,
+                      rejected_tenants: Sequence[str] = ()) -> Dict[str, dict]:
+    """Per-tenant latency/goodput table (shared by :func:`run_trace` and the
+    Router's report): delivery-gap ITL percentiles, TTFT, goodput (tokens of
+    in-deadline streams only), and the shed/expiry counts — the isolation
+    surface the fairness bench asserts on (one tenant's burst must not move
+    another tenant's p99)."""
+    rej = list(rejected_tenants)
+    tenants = sorted({c.tenant for c in completions} | set(rej))
+    out: Dict[str, dict] = {}
+    for t in tenants:
+        comps = [c for c in completions if c.tenant == t]
+        gaps: List[float] = []
+        for c in comps:
+            ts = tok_ts.get(c.request_id, np.zeros((0,)))
+            g = np.diff(ts) * 1e3 if ts.size > 1 else np.zeros((0,))
+            gaps.extend(g[g > 0.0].tolist())
+        ontime = sum(len(c.tokens) for c in comps
+                     if not (c.deadline_missed or c.expired or c.cancelled))
+        out[t] = {
+            "requests": len(comps),
+            "generated_tokens": int(sum(len(c.tokens) for c in comps)),
+            "itl_p50_ms": round(float(np.percentile(gaps, 50)), 3)
+            if gaps else None,
+            "itl_p99_ms": round(float(np.percentile(gaps, 99)), 3)
+            if gaps else None,
+            "ttft_blocks_mean": round(float(np.mean(
+                [c.ttft_blocks for c in comps])), 2) if comps else None,
+            "ttft_blocks_p99": int(np.percentile(
+                [c.ttft_blocks for c in comps], 99)) if comps else None,
+            "goodput_tokens_per_sec": (round(ontime / wall_s, 1)
+                                       if wall_s > 0 else None),
+            "rejected": rej.count(t),
+            "expired": sum(1 for c in comps if c.expired),
+            "deadline_missed": sum(1 for c in comps if c.deadline_missed),
+        }
+    return out
 
 
 def run_trace(engine: ServeEngine, trace: List[dict],
@@ -1680,12 +1884,16 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     directly."""
     if not engine.tracer.enabled:
         engine.tracer.enabled = True
+    tenant_of: Dict[int, str] = {}
     for item in trace:
-        engine.submit(item["prompt"], item["max_new_tokens"],
-                      eos_token_id=item.get("eos_token_id"),
-                      arrival_block=item.get("arrival_block", 0),
-                      ttft_deadline_ms=item.get("ttft_deadline_ms"),
-                      deadline_ms=item.get("deadline_ms"))
+        out = engine.submit(item["prompt"], item["max_new_tokens"],
+                            eos_token_id=item.get("eos_token_id"),
+                            arrival_block=item.get("arrival_block", 0),
+                            ttft_deadline_ms=item.get("ttft_deadline_ms"),
+                            deadline_ms=item.get("deadline_ms"),
+                            tenant=item.get("tenant", "default"))
+        rid = out.request_id if isinstance(out, Rejected) else out
+        tenant_of[rid] = item.get("tenant", "default")
     t0 = time.perf_counter()
     completions = engine.run(max_blocks=max_blocks,
                              snapshot_path=snapshot_path)
@@ -1793,6 +2001,14 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         "trace_events": len(engine.tracer.events()),
         "trace_events_dropped": engine.tracer.dropped,
     })
+    # per-tenant isolation surface (present whenever the trace labels
+    # tenants): the aggregate numbers above hide exactly the thing a quota
+    # system exists to protect — whose p99 a burst moved
+    if any(t != "default" for t in tenant_of.values()):
+        report["per_tenant"] = per_tenant_report(
+            completions, tok_ts, wall_s,
+            [tenant_of.get(r.request_id, "default")
+             for r in engine.rejected])
     if engine._injector is not None:
         report["fault_stats"] = dict(engine._injector.stats)
     pkv = getattr(engine.session, "paged", None)

@@ -26,6 +26,14 @@ in the same order, so "replay the storm" is a one-line reproducer:
   index, and re-prefills every affected request from its host-side
   (prompt, generated) record — the per-request rng contract makes the
   recovered stream bit-identical, which the chaos tests assert.
+* **replica** (``FaultInjector.replica_crash``) — per ROUTER block, a live
+  serving replica may go dark mid-block (its block's emissions are lost and
+  its heartbeat stops). The Router detects the silence after
+  ``heartbeat_miss_blocks`` and fails every placed request over to the
+  surviving replicas, replaying from its own (prompt, generated) records
+  or the replica's last snapshot — streams stay bit-identical because
+  token t of request r draws ``fold_in(fold_in(base, r), t)`` regardless
+  of which replica serves it.
 
 Decisions are drawn from PER-SEAM ``RandomState`` streams (seed folded with
 the seam name), so adding draws at one seam never perturbs another — the
@@ -64,15 +72,21 @@ class FaultPlan:
     dispatch_fail_prob: float = 0.0
     dispatch_max_failures: int = 1
     corrupt_page_prob: float = 0.0
+    replica_crash_prob: float = 0.0
+    max_replica_crashes: int = 1
 
     def __post_init__(self):
         for name in ("pool_exhaust_prob", "dispatch_fail_prob",
-                     "corrupt_page_prob"):
+                     "corrupt_page_prob", "replica_crash_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
         if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
             raise ValueError("storm lengths must be >= 1")
+        if self.max_replica_crashes < 0:
+            raise ValueError(
+                f"max_replica_crashes must be >= 0, got "
+                f"{self.max_replica_crashes}")
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
@@ -101,12 +115,13 @@ class FaultInjector:
         self._rs = {
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
-            for seam in ("alloc", "dispatch", "corrupt")
+            for seam in ("alloc", "dispatch", "corrupt", "replica")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
+        self._replica_crashes_done = 0
         self.stats = {"alloc_faults": 0, "dispatch_faults": 0,
-                      "pages_corrupted": 0}
+                      "pages_corrupted": 0, "replica_crashes": 0}
 
     # --- allocator seam --------------------------------------------------
 
@@ -142,6 +157,29 @@ class FaultInjector:
             self._fail_left[kind] = self.plan.dispatch_max_failures - 1
             self.stats["dispatch_faults"] += 1
             raise TransientDispatchError(f"injected {kind} dispatch failure")
+
+    # --- replica seam ----------------------------------------------------
+
+    def replica_crash(self, alive: Sequence[int]) -> Optional[int]:
+        """Per ROUTER block: pick at most one live replica to crash (None =
+        no fault this block). Bounded by ``max_replica_crashes`` so a plan
+        cannot take the whole fleet down; the Router additionally refuses
+        to crash the last live replica (there would be nowhere to fail
+        over, i.e. a correlated total outage — out of scope for the
+        single-router recovery story)."""
+        p = self.plan.replica_crash_prob
+        if (not p or not len(alive)
+                or self._replica_crashes_done
+                >= self.plan.max_replica_crashes):
+            return None
+        rs = self._rs["replica"]
+        if rs.random_sample() < p:
+            victim = int(sorted(int(x) for x in alive)[
+                rs.randint(len(alive))])
+            self._replica_crashes_done += 1
+            self.stats["replica_crashes"] += 1
+            return victim
+        return None
 
     # --- corruption seam -------------------------------------------------
 

@@ -143,6 +143,39 @@ class RadixPrefixIndex:
             node = child
         return pages
 
+    def peek(self, tokens: Sequence[int]) -> List[int]:
+        """Read-only :meth:`lookup`: physical page ids of the longest cached
+        page-aligned prefix WITHOUT touching the LRU clock or taking any
+        hold — the Router's prefix-affinity probe (it peeks every replica
+        per placement; a probe that refreshed LRU stamps would let routing
+        queries keep dead prefixes resident)."""
+        ps = self.page_size
+        node, pages = self.root, []
+        for i in range(len(tokens) // ps):
+            child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def evictable_pages(self) -> int:
+        """Pages LRU eviction could return to the free list right now:
+        cache-only (refcount 1) nodes whose whole subtree is also cache-only
+        (eviction frees leaves first, so a cache-only node above a slot-held
+        page stays pinned). The scheduler's pool-feasibility probe."""
+        def count(node) -> Tuple[int, bool]:
+            total, all_ev = 0, True
+            for c in node.children.values():
+                t, ev = count(c)
+                total += t
+                all_ev = all_ev and ev
+            if all_ev and self.allocator.refcount[node.page] == 1:
+                return total + 1, True
+            return total, False
+
+        return sum(count(c)[0] for c in self.root.children.values())
+
     def register(self, tokens: Sequence[int], pages: Sequence[int]) -> None:
         """Record prompt pages AFTER their K/V were written. A page whose
         path already exists keeps the existing entry (the new physical copy
@@ -500,6 +533,22 @@ class PagedKVCache:
         self.tables[slot] = self.scratch[slot]
 
     # --- introspection ---------------------------------------------------
+
+    def prefix_peek(self, tokens: Sequence[int]) -> int:
+        """Length in TOKENS of the cached page-aligned prefix an admission
+        of ``tokens`` would reuse — WITHOUT admitting: no hold taken, no
+        stats counted, no LRU touch (``RadixPrefixIndex.peek``). The
+        Router's prefix-affinity placement queries every replica with this
+        and sends the request where its prefix is hot. Clamped below the
+        last prompt token, exactly like :meth:`plan` — the peek must
+        predict the real admission's reuse, not overstate it."""
+        if self.prefix is None:
+            return 0
+        plen = len(tokens)
+        if plen < 1:
+            return 0
+        hit = self.prefix.peek(list(tokens))[: (plen - 1) // self.page_size]
+        return len(hit) * self.page_size
 
     def live_pages(self) -> List[int]:
         """Sorted physical ids of every page a LIVE slot currently holds —

@@ -1,0 +1,898 @@
+"""Multi-replica serving front door: a fault-tolerant :class:`Router`
+driving N :class:`ServeEngine` replicas on one shared virtual block clock.
+
+One engine is one slot pool; the paper's L4 service layer (and every
+Orca-style production deployment) fronts many model replicas with a router
+that owns placement, tenant isolation, and failure handling. All replicas
+share ONE :class:`CausalLM` (compiled programs are per-lm, so N replicas
+cost N sessions, not N compiles) and ONE rng base key — which is the whole
+recovery story: token t of request r draws ``fold_in(fold_in(base, r), t)``
+no matter which replica serves it, so a stream can migrate between replicas
+mid-flight and stay bit-identical to the single-replica oracle. The Router
+assigns globally-unique request ids and pins them at the engines
+(``submit(request_id=)``), making that invariant real.
+
+Placement (per block, over the arrived backlog in fairness order):
+
+* **prefix affinity** — every live replica is probed with
+  ``PagedKVCache.prefix_peek`` (read-only: no holds, no stats, no LRU
+  touch); a request goes where the longest page-aligned prefix of its
+  prompt is already hot, so shared-system-prompt traffic concentrates its
+  radix reuse instead of smearing cold prefills across the fleet;
+* **least-loaded / deadline-aware fallback** — no hot replica: the request
+  goes to the replica with the earliest feasible TTFT (free slots first,
+  then shortest backlog, breaking ties by free pages), and a structured
+  :class:`Rejected` bounced back by a replica (queue bound, pool
+  exhaustion) is honored: the request re-queues with the verdict's
+  ``retry_after_blocks`` backoff (capped), up to ``max_requeues`` times
+  before the rejection surfaces to the client;
+* **round_robin** — the measurement baseline the bench compares against.
+
+Per-tenant fairness (start-time fair queueing over token cost):
+
+* ``submit(tenant=...)`` labels every request; each tenant holds a weight
+  (default 1.0) and the router keeps a virtual-time frontier per tenant:
+  request cost = (prompt + budget tokens) / weight, placement order is by
+  virtual finish tag — a bursting tenant's backlog earns ever-later tags
+  while a compliant tenant's sparse requests keep jumping ahead, so the
+  burst queues behind ITS OWN traffic instead of starving everyone
+  (WFQ's guarantee, at admission-slot granularity since streams are not
+  preempted);
+* shedding is tenant-aware: when ``max_pending`` overflows, the victim
+  comes from the tenant FURTHEST over its weighted share of the backlog,
+  newest-first — the over-budget tenant's tail pays, never a compliant
+  tenant's head.
+
+Replica failure (the chaos seam) and graceful drain:
+
+* a replica "goes dark" mid-block (``FaultPlan.replica_crash_prob`` —
+  seeded, replayable — or a scheduled ``crash_at``): its current block's
+  emissions are lost and its heartbeat stops. The router detects the
+  silence after ``heartbeat_miss_blocks`` on the block clock and fails
+  every placed request over: replayed onto surviving replicas from the
+  crashed replica's last snapshot (``snapshot_every_blocks``) or from the
+  router's own per-request (prompt, generated) delivery records — both
+  resume bit-identical (the rng contract above); queued/mid-prefill work
+  simply re-places. The failover wall cost is recorded
+  (``last_failover_ms``) — it is the bench's ``serve_failover_replay_ms``;
+* ``drain(replica)`` is the rolling-restart primitive: placement stops,
+  queued + mid-prefill + pending-replay requests migrate to peers
+  (mid-prefill unwinds atomically through the abort machinery — zero
+  tokens lost), live DECODING streams finish where they are, and the
+  drained replica's final state is snapshotted (``snapshots[i]``) for the
+  restart.
+
+Observability: one shared :class:`Tracer` carries every replica's engine
+lanes (each replica records under its own ``replica<i>`` process — the
+per-replica queue-depth counter tracks) plus the router's own lanes
+(``("router", "place"|"clock"|"faults"|"drain")``: place/route instants,
+heartbeat misses, failover/drain spans); the router's
+:class:`MetricsRegistry` holds the tenant-labeled families
+(``router_tenant_requests_total{tenant=...}`` etc.). Engines keep their own
+registries — per-replica counters must not sum silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from neuronx_distributed_tpu.inference.engine import (
+    Completion,
+    Rejected,
+    Request,
+    ServeEngine,
+    per_tenant_report,
+)
+from neuronx_distributed_tpu.inference.faults import FaultInjector, FaultPlan
+from neuronx_distributed_tpu.observability import MetricsRegistry, Tracer
+
+
+class NoLiveReplicas(RuntimeError):
+    """Every replica is dead or drained while work is still pending — the
+    router has nowhere left to place; a supervisor must restart capacity
+    (the drained snapshots + router records make that restart exact)."""
+
+
+@dataclasses.dataclass
+class _Tenant:
+    """Start-time-fair-queueing state for one tenant: the weight is its
+    share, ``finish`` the virtual-time frontier its next request queues
+    behind."""
+
+    weight: float = 1.0
+    finish: float = 0.0
+    submitted: int = 0
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One router-queue item awaiting placement. ``replay`` entries carry a
+    ``generated`` prefix (failover work — they place ahead of everything,
+    through the engine's resume path); ``not_before`` is the earliest
+    placement block (arrival time or a rejection's retry-after backoff)."""
+
+    req: Request
+    v_start: float = 0.0
+    finish_tag: float = 0.0
+    not_before: int = 0
+    replay: bool = False
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _Record:
+    """The router's authoritative per-request bookkeeping: where it is
+    placed, what was already delivered to the client (the failover replay
+    source), and how often it was bounced (the re-queue cap)."""
+
+    req: Request
+    tenant: str
+    finish_tag: float
+    v_start: float
+    replica: Optional[int] = None
+    delivered: List[int] = dataclasses.field(default_factory=list)
+    requeues: int = 0
+
+
+class Router:
+    """Front door over ``num_replicas`` :class:`ServeEngine` replicas.
+
+    ``**engine_kw`` (block_steps, fused, prefill_chunk_tokens, max_queue,
+    shed_policy, block_time_ms, ...) is forwarded to every replica, so the
+    fleet is homogeneous; ``placement`` picks the routing policy
+    ('affinity' — prefix-affinity with least-loaded fallback, the default —
+    'least_loaded', or 'round_robin', the bench baseline). ``faults``
+    arms the shared :class:`FaultInjector` at every replica's
+    engine seams AND the router's replica-crash seam."""
+
+    def __init__(
+        self,
+        lm,
+        num_replicas: int = 2,
+        *,
+        placement: str = "affinity",
+        tenant_weights: Optional[Dict[str, float]] = None,
+        max_pending: Optional[int] = None,
+        heartbeat_miss_blocks: int = 2,
+        max_requeues: int = 8,
+        retry_after_cap_blocks: int = 16,
+        replica_queue_depth: int = 0,
+        snapshot_every_blocks: int = 0,
+        record_streams: bool = True,
+        faults: Optional[Union[FaultPlan, FaultInjector]] = None,
+        crash_at: Sequence[Tuple[int, int]] = (),
+        rng: Optional[jax.Array] = None,
+        trace: bool = False,
+        tracer: Optional[Tracer] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        **engine_kw,
+    ):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        if placement not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(
+                f"placement must be 'affinity', 'least_loaded' or "
+                f"'round_robin', got {placement!r}")
+        if heartbeat_miss_blocks < 1:
+            raise ValueError(
+                f"heartbeat_miss_blocks must be >= 1, got "
+                f"{heartbeat_miss_blocks}")
+        if max_pending is not None and max_pending < 0:
+            raise ValueError(f"max_pending must be >= 0, got {max_pending}")
+        self.placement = placement
+        self.max_pending = max_pending
+        self.heartbeat_miss_blocks = int(heartbeat_miss_blocks)
+        self.max_requeues = int(max_requeues)
+        self.retry_after_cap_blocks = int(retry_after_cap_blocks)
+        self.replica_queue_depth = int(replica_queue_depth)
+        self.snapshot_every_blocks = int(snapshot_every_blocks)
+        self.record_streams = bool(record_streams)
+        self.rng = rng if rng is not None else jax.random.key(0)
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=bool(trace))
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._injector: Optional[FaultInjector] = None
+        if faults is not None:
+            self._injector = (faults if isinstance(faults, FaultInjector)
+                              else FaultInjector(faults))
+        # the fleet: one lm (shared compiled programs), N sessions. All
+        # replicas take the SAME rng base — with router-assigned globally-
+        # unique ids that makes streams replica-independent by construction.
+        self.engines: List[ServeEngine] = [
+            ServeEngine(lm, rng=self.rng, name=f"replica{i}",
+                        tracer=self.tracer, faults=self._injector,
+                        **engine_kw)
+            for i in range(num_replicas)
+        ]
+        self.crash_at = [(int(b), int(i)) for b, i in crash_at]
+        for _b, i in self.crash_at:
+            if not 0 <= i < num_replicas:
+                raise ValueError(f"crash_at names unknown replica {i}")
+        n = num_replicas
+        self.blocks = 0
+        self._next_id = 0
+        self._vtime = 0.0
+        self._tenants: Dict[str, _Tenant] = {}
+        self._tenant_weights = dict(tenant_weights or {})
+        self.pending: deque[_Entry] = deque()
+        self.completed: List[Completion] = []
+        self.rejected: List[Rejected] = []
+        self._records: Dict[int, _Record] = {}
+        self._tenant_of: Dict[int, str] = {}
+        self._alive = [True] * n
+        self._dark: set = set()
+        self._draining: set = set()
+        self._drained: set = set()
+        self._hb = [0] * n                      # last heartbeat block
+        self._hc = [0] * n                      # harvested completions
+        self._hr = [0] * n                      # harvested rejections
+        self._drain_t0: Dict[int, float] = {}
+        self.snapshots: Dict[int, dict] = {}
+        self._rr_next = 0
+        self.last_failover_ms: Optional[float] = None
+        self.last_drain_ms: Optional[float] = None
+        self.stats = {
+            "placements": 0, "affinity_placements": 0, "requeues": 0,
+            "rejected": 0, "shed_evictions": 0, "crashes": 0,
+            "heartbeat_misses": 0, "failovers": 0, "failed_over_requests": 0,
+            "drains": 0, "drain_migrated_requests": 0, "snapshots_taken": 0,
+        }
+        self._m_pending = self.metrics.gauge(
+            "router_pending_depth", help="arrived router backlog")
+        self._m_placements = self.metrics.counter(
+            "router_placements_total", help="requests placed on replicas")
+
+    # --- tenants / fairness ----------------------------------------------
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(
+                weight=float(self._tenant_weights.get(name, 1.0)))
+            if t.weight <= 0:
+                raise ValueError(
+                    f"tenant {name!r} weight must be > 0, got {t.weight}")
+        return t
+
+    def set_tenant_weight(self, name: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        self._tenant_weights[name] = float(weight)
+        self._tenant(name).weight = float(weight)
+
+    @staticmethod
+    def _cost(req: Request) -> float:
+        """WFQ service cost of one request: its whole token footprint.
+        Prompt tokens count too — a prefill occupies the replica exactly
+        like decode work does."""
+        return float(req.prompt.size + req.max_new_tokens)
+
+    def _arrived(self, e: _Entry) -> bool:
+        return (e.req.arrival_block <= self.blocks
+                and e.not_before <= self.blocks)
+
+    def _placement_order(self) -> List[_Entry]:
+        """Arrived backlog in placement order: failover replays first (the
+        client is mid-stream — they outrank any fresh admission), then WFQ
+        virtual finish tags, ids as the deterministic tiebreak."""
+        arrived = [e for e in self.pending if self._arrived(e)]
+        arrived.sort(key=lambda e: (not e.replay, e.finish_tag,
+                                    e.req.request_id))
+        return arrived
+
+    # --- submission -------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               tenant: str = "default", sampler=None,
+               eos_token_id: Optional[int] = None, arrival_block: int = 0,
+               ttft_deadline_ms: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Union[int, Rejected]:
+        """Queue a request with the router (placement happens at block
+        boundaries); returns its globally-unique id, or a structured
+        :class:`Rejected` when tenant-aware shedding refuses it. Deadlines
+        are budgets relative to ``arrival_block`` on the SHARED clock — a
+        wait in the router queue spends the budget exactly like a wait in a
+        replica queue would."""
+        probe = self.engines[0]
+        prompt, sampler, greedy = probe._validate_submit(
+            prompt, max_new_tokens, sampler)
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid, prompt=prompt,
+            max_new_tokens=int(max_new_tokens), eos_token_id=eos_token_id,
+            temperature=0.0 if greedy else float(sampler.temperature),
+            greedy=greedy, arrival_block=int(arrival_block),
+            submit_block=self.blocks,
+            ttft_deadline_block=probe._deadline_block(
+                arrival_block, ttft_deadline_ms, "ttft_deadline_ms"),
+            deadline_block=probe._deadline_block(
+                arrival_block, deadline_ms, "deadline_ms"),
+            tenant=str(tenant),
+        )
+        t = self._tenant(req.tenant)
+        t.submitted += 1
+        start = max(self._vtime, t.finish)
+        t.finish = start + self._cost(req) / t.weight
+        entry = _Entry(req=req, v_start=start, finish_tag=t.finish,
+                       not_before=int(arrival_block))
+        self._tenant_of[rid] = req.tenant
+        self.metrics.counter("router_tenant_requests_total",
+                             help="requests submitted per tenant",
+                             tenant=req.tenant).inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "route_submit", ("router", "place"), block=self.blocks,
+                args={"rid": rid, "tenant": req.tenant,
+                      "prompt_len": int(prompt.size),
+                      "max_new_tokens": int(max_new_tokens),
+                      "finish_tag": round(t.finish, 3)})
+        if (self.max_pending is not None
+                and req.arrival_block <= self.blocks):
+            arrived = [e for e in self.pending if self._arrived(e)]
+            if len(arrived) >= self.max_pending + self._free_capacity():
+                verdict = self._shed_tenant(entry, arrived)
+                if verdict is not None:
+                    return verdict
+        self.pending.append(entry)
+        self._records[rid] = _Record(req=req, tenant=req.tenant,
+                                     finish_tag=entry.finish_tag,
+                                     v_start=entry.v_start)
+        self._m_pending.set(sum(1 for e in self.pending if self._arrived(e)))
+        return rid
+
+    def _free_capacity(self) -> int:
+        return sum(len(self.engines[i]._free_slots())
+                   for i in self._live_replicas())
+
+    def _retry_after(self) -> int:
+        """Fleet-wide backlog-drain estimate in blocks (the shed verdict's
+        resubmission hint): undelivered token budget over the live
+        replicas' aggregate K*slots service rate."""
+        pend = sum(e.req.max_new_tokens - len(e.generated)
+                   for e in self.pending)
+        inflight = 0
+        rate = 0
+        for i in self._live_replicas():
+            eng = self.engines[i]
+            inflight += sum(
+                r.max_new_tokens - len(eng._out.get(r.request_id, []))
+                for r in eng.slots if r is not None)
+            inflight += sum(r.max_new_tokens for r in eng.queue)
+            rate += eng.lm.max_batch * eng.block_steps
+        return max(1, -(-(pend + inflight) // max(rate, 1)))
+
+    def _shed_tenant(self, newcomer: _Entry,
+                     arrived: List[_Entry]) -> Optional[Rejected]:
+        """Tenant-aware overflow: the victim tenant is the one FURTHEST
+        over its weighted share of the arrived backlog (cost/weight), and
+        within it the newest entry sheds first — a burst eats its own tail.
+        Returns the newcomer's verdict, or None when a queued entry shed
+        instead (the newcomer is admitted in its place)."""
+        usage: Dict[str, float] = {}
+        for e in arrived + [newcomer]:
+            t = self._tenant(e.req.tenant)
+            usage[e.req.tenant] = (usage.get(e.req.tenant, 0.0)
+                                   + self._cost(e.req) / t.weight)
+        victim_tenant = max(sorted(usage), key=lambda k: usage[k])
+        candidates = [e for e in arrived + [newcomer]
+                      if e.req.tenant == victim_tenant and not e.replay]
+        if not candidates:
+            candidates = [newcomer]
+        victim = max(candidates, key=lambda e: e.req.request_id)
+        rej = Rejected(
+            request_id=victim.req.request_id,
+            retry_after_blocks=min(self._retry_after(),
+                                   self.retry_after_cap_blocks),
+            queue_depth=len(arrived),
+            reason="tenant_over_budget")
+        self.rejected.append(rej)
+        self.stats["rejected"] += 1
+        self.metrics.counter("router_tenant_shed_total",
+                             help="requests shed per tenant",
+                             tenant=victim.req.tenant).inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "shed", ("router", "place"), block=self.blocks,
+                args={"rid": victim.req.request_id,
+                      "tenant": victim.req.tenant,
+                      "reason": rej.reason,
+                      "retry_after_blocks": rej.retry_after_blocks})
+        if victim is newcomer:
+            return rej
+        self.pending.remove(victim)
+        self._records.pop(victim.req.request_id, None)
+        self.stats["shed_evictions"] += 1
+        return None
+
+    # --- placement --------------------------------------------------------
+
+    def _live_replicas(self) -> List[int]:
+        return [i for i in range(len(self.engines))
+                if self._alive[i] and i not in self._dark
+                and i not in self._draining and i not in self._drained]
+
+    def _can_take(self, i: int, req: Request) -> bool:
+        """Placement admission gate: a replica takes new work only while it
+        has an UNCLAIMED free slot (free slots beyond its own queued
+        backlog) and pool room — deeper backlogs stay at the router, where
+        fairness ordering and affinity still apply. Work pushed eagerly
+        into a replica queue could neither be re-ordered fairly nor
+        re-routed to a hotter prefix: replica-side queueing front-runs WFQ,
+        so it is off by default (``replica_queue_depth=0``); raising the
+        knob trades fairness granularity for placement latency."""
+        eng = self.engines[i]
+        if (len(eng._free_slots()) > len(eng.queue)
+                and eng._pool_can_admit(req.prompt.size,
+                                        req.max_new_tokens)):
+            return True
+        return len(eng.queue) < self.replica_queue_depth
+
+    def _load_score(self, i: int, req: Request) -> Tuple:
+        """Least-loaded / deadline-aware ordering key (smaller is better):
+        estimated TTFT in blocks first (0 with a free slot + pool room,
+        else the soonest retirement estimate plus the queued backlog),
+        then backlog depth, then fewest pages in use."""
+        eng = self.engines[i]
+        free = len(eng._free_slots())
+        backlog = (len(eng.queue) + len(eng._prefilling)
+                   + len(eng._replay_q))
+        if free and backlog == 0 and eng._pool_can_admit(
+                req.prompt.size, req.max_new_tokens):
+            est_ttft = 0
+        else:
+            est_ttft = eng._pool_retry_after() + backlog
+        pages = (eng.session.paged.allocator.in_use()
+                 if eng.paged and eng.session.paged is not None else 0)
+        return (est_ttft, backlog, -free, pages, i)
+
+    def _pick_replica(self, e: _Entry) -> Tuple[Optional[int], int]:
+        """Choose a replica for one entry; returns (replica, prefix_hit
+        tokens) — (None, 0) when nobody can take it this block."""
+        viable = [i for i in self._live_replicas()
+                  if self._can_take(i, e.req)]
+        if not viable:
+            return None, 0
+        if self.placement == "round_robin":
+            order = sorted(viable)
+            pick = order[self._rr_next % len(order)]
+            self._rr_next += 1
+            return pick, 0
+        if self.placement == "affinity":
+            hits = {}
+            for i in viable:
+                pkv = self.engines[i].session.paged
+                if pkv is not None:
+                    hits[i] = pkv.prefix_peek(e.req.prompt.tolist())
+            best = max(hits.values()) if hits else 0
+            if best > 0:
+                hot = [i for i, h in hits.items() if h == best]
+                return min(hot, key=lambda i: self._load_score(i, e.req)), best
+        return min(viable, key=lambda i: self._load_score(i, e.req)), 0
+
+    def _place(self) -> None:
+        for e in self._placement_order():
+            i, hit = self._pick_replica(e)
+            if i is None:
+                continue
+            eng = self.engines[i]
+            rec = self._records.get(e.req.request_id)
+            if e.replay:
+                eng.resume(e.req, e.generated)
+                out: Union[int, Rejected] = e.req.request_id
+            else:
+                out = eng.submit_request(e.req)
+            if isinstance(out, Rejected):
+                # the replica bounced it (its own queue bound / pool
+                # pressure). Drop the entry here; the harvest pass — which
+                # also sees sheds the engine decides mid-run — honors the
+                # verdict's retry_after with a capped backoff re-queue
+                # (processing it in BOTH places would duplicate the
+                # request)
+                self.pending.remove(e)
+                continue
+            self.pending.remove(e)
+            self._vtime = max(self._vtime, e.v_start)
+            if rec is not None:
+                rec.replica = i
+            self.stats["placements"] += 1
+            self._m_placements.inc()
+            self.metrics.counter("router_replica_placements_total",
+                                 help="placements per replica",
+                                 replica=str(i)).inc()
+            if hit:
+                self.stats["affinity_placements"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "place", ("router", "place"), block=self.blocks,
+                    args={"rid": e.req.request_id, "replica": i,
+                          "tenant": e.req.tenant, "policy": self.placement,
+                          "prefix_hit_tokens": int(hit),
+                          "replay": bool(e.replay),
+                          "resumed_at": len(e.generated) if e.replay
+                          else None})
+
+    def _requeue_or_reject(self, e: _Entry, rej: Rejected) -> None:
+        rec = self._records.get(e.req.request_id)
+        if rec is not None:
+            rec.requeues += 1
+            rec.replica = None
+            requeues = rec.requeues
+        else:
+            requeues = self.max_requeues + 1   # no record left: surface it
+        if requeues > self.max_requeues:
+            self.rejected.append(rej)
+            self.stats["rejected"] += 1
+            self._records.pop(e.req.request_id, None)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "reject", ("router", "place"), block=self.blocks,
+                    args={"rid": e.req.request_id, "reason": rej.reason,
+                          "requeues": requeues})
+            return
+        e.not_before = self.blocks + max(
+            1, min(rej.retry_after_blocks, self.retry_after_cap_blocks))
+        if rec is not None:
+            rec.replica = None
+        self.pending.append(e)
+        self.stats["requeues"] += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "requeue", ("router", "place"), block=self.blocks,
+                args={"rid": e.req.request_id, "reason": rej.reason,
+                      "not_before": e.not_before})
+
+    # --- failure injection / detection / failover -------------------------
+
+    def crash_replica(self, i: int) -> None:
+        """Take replica ``i`` dark NOW (ops drill / test seam): its current
+        block's emissions are lost and its heartbeat stops; the router
+        notices after ``heartbeat_miss_blocks`` and fails its requests
+        over."""
+        if not (0 <= i < len(self.engines)):
+            raise ValueError(f"unknown replica {i}")
+        if not self._alive[i] or i in self._dark or i in self._drained:
+            raise ValueError(f"replica {i} is not live")
+        self._go_dark(i, "manual")
+
+    def _go_dark(self, i: int, why: str) -> None:
+        self._dark.add(i)
+        self._draining.discard(i)
+        self.stats["crashes"] += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "fault:replica_crash", ("router", "faults"),
+                block=self.blocks,
+                args={"replica": i, "why": why,
+                      "last_heartbeat_block": self._hb[i]})
+
+    def _inject_crashes(self) -> None:
+        for b, i in self.crash_at:
+            if (b == self.blocks and self._alive[i]
+                    and i not in self._dark and i not in self._drained):
+                self._go_dark(i, "scheduled")
+        if self._injector is not None:
+            live = self._live_replicas()
+            if len(live) >= 2:     # never crash the last live replica
+                victim = self._injector.replica_crash(live)
+                if victim is not None:
+                    self._go_dark(victim, "injected")
+
+    def _detect_failures(self) -> None:
+        for i in sorted(self._dark):
+            if self.blocks - self._hb[i] > self.heartbeat_miss_blocks:
+                self.stats["heartbeat_misses"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "heartbeat_miss", ("router", "faults"),
+                        block=self.blocks,
+                        args={"replica": i,
+                              "last_heartbeat_block": self._hb[i],
+                              "missed_blocks": self.blocks - self._hb[i]})
+                self._failover(i)
+
+    def _failover(self, i: int) -> None:
+        """Fail every request placed on dark replica ``i`` over to the
+        survivors: resume records come from the router's per-request
+        delivery log (``record_streams``) or, when the router does not keep
+        one, the replica's last snapshot — a request in neither replays
+        from scratch, which the rng contract makes equally exact (the
+        client just re-receives a deterministic prefix)."""
+        t0 = time.perf_counter()
+        self._dark.discard(i)
+        self._alive[i] = False
+        snap = self.snapshots.get(i)
+        snap_gen: Dict[int, List[int]] = {}
+        if snap is not None:
+            snap_gen = {int(r["request_id"]): [int(t) for t in r["generated"]]
+                        for r in snap.get("requests", ())}
+        moved = 0
+        for rid in sorted(self._records, reverse=True):
+            rec = self._records[rid]
+            if rec.replica != i:
+                continue
+            gen = (list(rec.delivered) if self.record_streams
+                   else snap_gen.get(rid, []))
+            rec.replica = None
+            rec.delivered = list(gen)
+            self.pending.appendleft(_Entry(
+                req=rec.req, v_start=rec.v_start, finish_tag=rec.finish_tag,
+                replay=True, generated=gen))
+            moved += 1
+        self.stats["failovers"] += 1
+        self.stats["failed_over_requests"] += moved
+        self.last_failover_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "failover", ("router", "faults"), t0, time.perf_counter(),
+                block=self.blocks,
+                args={"replica": i, "requests": moved,
+                      "from_snapshot": not self.record_streams
+                      and snap is not None})
+
+    # --- graceful drain ---------------------------------------------------
+
+    def drain(self, i: int) -> None:
+        """Begin a graceful drain of replica ``i`` (rolling restarts):
+        placement stops immediately, its queued + mid-prefill + pending-
+        replay requests migrate to peers (mid-prefill pages roll back
+        atomically — zero tokens lost), live decoding streams finish in
+        place; once the last one retires the replica's state is
+        snapshotted into ``snapshots[i]`` and it parks."""
+        if not (0 <= i < len(self.engines)):
+            raise ValueError(f"unknown replica {i}")
+        if not self._alive[i] or i in self._dark or i in self._drained:
+            raise ValueError(f"replica {i} is not live")
+        if i in self._draining:
+            return
+        self._draining.add(i)
+        self._drain_t0[i] = time.perf_counter()
+        self._migrate_placeable(i)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "drain_begin", ("router", "drain"), block=self.blocks,
+                args={"replica": i})
+
+    def _migrate_placeable(self, i: int) -> None:
+        """Pull everything not actively decoding off replica ``i`` and
+        re-queue it at the router (front, original fairness tags — a
+        migration must not re-charge the tenant)."""
+        eng = self.engines[i]
+        moved: List[_Entry] = []
+        for req in eng.extract_queued():
+            moved.append(self._reentry(req, replay=False))
+        for req in eng.extract_prefilling():
+            moved.append(self._reentry(req, replay=False))
+        for req, gen in eng.extract_replays():
+            moved.append(self._reentry(req, replay=True, generated=gen))
+        for e in sorted(moved, key=lambda e: e.req.request_id, reverse=True):
+            self.pending.appendleft(e)
+        self.stats["drain_migrated_requests"] += len(moved)
+
+    def _reentry(self, req: Request, replay: bool,
+                 generated: Optional[List[int]] = None) -> _Entry:
+        rec = self._records.get(req.request_id)
+        if rec is not None:
+            rec.replica = None
+            e = _Entry(req=req, v_start=rec.v_start,
+                       finish_tag=rec.finish_tag, replay=replay,
+                       generated=list(generated or []))
+        else:
+            e = _Entry(req=req, replay=replay,
+                       generated=list(generated or []))
+        return e
+
+    def _finish_drains(self) -> None:
+        for i in sorted(self._draining):
+            eng = self.engines[i]
+            # corruption recovery may have parked replays mid-drain:
+            # migrate them too rather than re-prefilling on a dying replica
+            if eng._replay_q:
+                self._migrate_placeable(i)
+            if eng.has_decode_work():
+                continue
+            self.snapshots[i] = eng.snapshot()
+            self._draining.discard(i)
+            self._drained.add(i)
+            self.stats["drains"] += 1
+            t0 = self._drain_t0.pop(i, time.perf_counter())
+            self.last_drain_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    "drain", ("router", "drain"), t0, time.perf_counter(),
+                    block=self.blocks, args={"replica": i})
+
+    # --- the block loop ---------------------------------------------------
+
+    def _harvest(self, i: int) -> None:
+        """Pull replica ``i``'s freshly-finished completions/rejections and
+        refresh the router's per-request delivery records — the records a
+        failover replays from, updated every block so at most ONE block of
+        deliveries is ever re-sent."""
+        eng = self.engines[i]
+        for c in eng.completed[self._hc[i]:]:
+            self.completed.append(c)
+            self._records.pop(c.request_id, None)
+            self.metrics.counter("router_tenant_tokens_total",
+                                 help="tokens delivered per tenant",
+                                 tenant=c.tenant).inc(len(c.tokens))
+        self._hc[i] = len(eng.completed)
+        for rej in eng.rejected[self._hr[i]:]:
+            rec = self._records.get(rej.request_id)
+            if rec is None:
+                continue
+            e = _Entry(req=rec.req, v_start=rec.v_start,
+                       finish_tag=rec.finish_tag)
+            self._requeue_or_reject(e, rej)
+        self._hr[i] = len(eng.rejected)
+        if self.record_streams:
+            for rid, toks in eng._out.items():
+                rec = self._records.get(rid)
+                if rec is not None:
+                    rec.delivered = list(toks)
+
+    def _observe_block(self) -> None:
+        depth = sum(1 for e in self.pending if self._arrived(e))
+        self._m_pending.set(depth)
+        if self.tracer.enabled:
+            self.tracer.counter("router_pending", ("router", "clock"),
+                                depth, block=self.blocks)
+
+    def step_block(self) -> bool:
+        """One router round on the shared clock: inject/detect crashes,
+        finish drains, place the arrived backlog, advance every live
+        replica one engine block (their clocks are pinned to the router's),
+        harvest deliveries. Returns False when nothing is left anywhere."""
+        self._inject_crashes()
+        self._detect_failures()
+        self._finish_drains()
+        self._place()
+        progressed = False
+        for i, eng in enumerate(self.engines):
+            if (not self._alive[i] or i in self._dark
+                    or i in self._drained):
+                continue
+            eng.blocks = self.blocks
+            if eng.step_block():
+                progressed = True
+            self._hb[i] = self.blocks
+            self._harvest(i)
+        if (self.snapshot_every_blocks
+                and (self.blocks + 1) % self.snapshot_every_blocks == 0):
+            for i in self._live_replicas():
+                self.snapshots[i] = self.engines[i].snapshot()
+                self.stats["snapshots_taken"] += 1
+        self._observe_block()
+        self.blocks += 1
+        work_left = (progressed or bool(self.pending) or bool(self._dark)
+                     or bool(self._draining))
+        if (self.pending and not self._live_replicas()
+                and not self._dark and not self._draining):
+            raise NoLiveReplicas(
+                f"{len(self.pending)} requests pending with every replica "
+                f"dead or drained")
+        return work_left
+
+    def run(self, max_blocks: Optional[int] = None) -> List[Completion]:
+        """Drive blocks until the fleet drains (or ``max_blocks`` elapse);
+        returns completions in finish order."""
+        n = 0
+        while self.step_block():
+            n += 1
+            if max_blocks is not None and n >= max_blocks:
+                break
+        return self.completed
+
+    # --- introspection ----------------------------------------------------
+
+    def replica_states(self) -> List[dict]:
+        out = []
+        for i, eng in enumerate(self.engines):
+            state = ("dark" if i in self._dark
+                     else "drained" if i in self._drained
+                     else "draining" if i in self._draining
+                     else "live" if self._alive[i] else "dead")
+            out.append({
+                "replica": i, "state": state,
+                "last_heartbeat_block": self._hb[i],
+                "queue_depth": len(eng.queue),
+                "active_slots": int(sum(1 for r in eng.slots
+                                        if r is not None)),
+                "decode_blocks": int(eng.stats["decode_blocks"]),
+                "inserted_requests": int(eng.stats["inserted_requests"]),
+                "pages_in_use": (eng.session.paged.allocator.in_use()
+                                 if eng.paged and eng.session.paged
+                                 is not None else None),
+            })
+        return out
+
+
+def run_router_trace(router: Router, trace: List[dict],
+                     max_blocks: Optional[int] = None) -> dict:
+    """Submit a synthetic trace to the Router and drive the fleet to
+    completion; returns the serving report in ``run_trace``'s shape plus
+    the router surface (per-replica states, placements, failovers, drains)
+    and the per-tenant isolation table. Turns tracing on (the wall
+    ITL surface reads the shared tracer's token events) exactly like
+    ``run_trace``."""
+    if not router.tracer.enabled:
+        router.tracer.enabled = True
+    for item in trace:
+        router.submit(item["prompt"], item["max_new_tokens"],
+                      eos_token_id=item.get("eos_token_id"),
+                      arrival_block=item.get("arrival_block", 0),
+                      ttft_deadline_ms=item.get("ttft_deadline_ms"),
+                      deadline_ms=item.get("deadline_ms"),
+                      tenant=item.get("tenant", "default"))
+    t0 = time.perf_counter()
+    completions = router.run(max_blocks=max_blocks)
+    wall_s = time.perf_counter() - t0
+    total_tokens = int(sum(len(c.tokens) for c in completions))
+    tok_ts = {
+        rid: np.asarray([ev["ts"] for ev in evs if ev["name"] == "tok"],
+                        np.float64)
+        for rid, evs in router.tracer.by_request().items()}
+    gaps_ms: List[float] = []
+    for c in completions:
+        ts = tok_ts.get(c.request_id, np.zeros((0,)))
+        g = np.diff(ts) * 1e3 if ts.size > 1 else np.zeros((0,))
+        gaps_ms.extend(g[g > 0.0].tolist())
+    submitted = len(trace)
+    rejected = len(router.rejected)
+    expired = sum(1 for c in completions if c.expired)
+    missed = sum(1 for c in completions if c.deadline_missed)
+    has_deadlines = any(item.get("deadline_ms")
+                        or item.get("ttft_deadline_ms") for item in trace)
+    ontime_tokens = sum(
+        len(c.tokens) for c in completions
+        if not (c.deadline_missed or c.expired or c.cancelled))
+    report = {
+        "replicas": len(router.engines),
+        "placement": router.placement,
+        "requests_completed": len(completions),
+        "total_generated_tokens": total_tokens,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_sec": (round(total_tokens / wall_s, 1)
+                           if wall_s > 0 else None),
+        "goodput_tokens_per_sec": (round(ontime_tokens / wall_s, 1)
+                                   if wall_s > 0 else None),
+        "blocks": router.blocks,
+        "rejected": rejected,
+        "expired": expired,
+        "deadline_miss_rate": (round((rejected + missed) / submitted, 4)
+                               if has_deadlines and submitted else None),
+        "itl_p50_ms": round(float(np.percentile(gaps_ms, 50)), 3)
+        if gaps_ms else None,
+        "itl_p99_ms": round(float(np.percentile(gaps_ms, 99)), 3)
+        if gaps_ms else None,
+        "ttft_blocks_mean": round(float(np.mean(
+            [c.ttft_blocks for c in completions])), 2)
+        if completions else None,
+        "placements": router.stats["placements"],
+        "affinity_placements": router.stats["affinity_placements"],
+        "requeues": router.stats["requeues"],
+        "crashes": router.stats["crashes"],
+        "failovers": router.stats["failovers"],
+        "failed_over_requests": router.stats["failed_over_requests"],
+        "drains": router.stats["drains"],
+        "last_failover_ms": router.last_failover_ms,
+        "last_drain_ms": router.last_drain_ms,
+        "replica_states": router.replica_states(),
+        "trace_events": len(router.tracer.events()),
+        "trace_events_dropped": router.tracer.dropped,
+    }
+    tenants = {item.get("tenant", "default") for item in trace}
+    if tenants != {"default"}:
+        report["per_tenant"] = per_tenant_report(
+            completions, tok_ts, wall_s,
+            [router._tenant_of.get(r.request_id, "default")
+             for r in router.rejected])
+    if router._injector is not None:
+        report["fault_stats"] = dict(router._injector.stats)
+    return report

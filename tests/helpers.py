@@ -22,8 +22,8 @@ def dispatch_counts(engine, kind=None):
     device->host copy). Requires the engine to run with ``trace=True``.
     Returns the {kind: count} dict, or one count when ``kind`` is given."""
     counts = {}
-    for ev in engine.tracer.events(lane_group="engine"):
-        if ev["lane"] == ("engine", "dispatch") and ev["ph"] == "X":
+    for ev in engine.tracer.events(lane_group=engine.lane):
+        if ev["lane"] == (engine.lane, "dispatch") and ev["ph"] == "X":
             counts[ev["name"]] = counts.get(ev["name"], 0) + 1
     return counts.get(kind, 0) if kind is not None else counts
 

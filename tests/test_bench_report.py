@@ -64,6 +64,11 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_deadline_miss_rate_shed": 0.41,
                                       "serve_deadline_miss_rate_noshed": 0.72,
                                       "serve_recovery_replay_ms": 118.0,
+                                      "serve_agg_goodput_2x_n4": 1980.0,
+                                      "serve_agg_goodput_2x_n4_rr": 1710.0,
+                                      "serve_tenant_p99_fairness_ratio": 1.08,
+                                      "serve_failover_replay_ms": 145.0,
+                                      "serve_drain_ms": 96.0,
                                       "serve_tracing_overhead_ratio": 0.993,
                                       "serve_tokens_per_sec_traced": 508.4,
                                       "serve_tokens_per_sec_untraced": 512.0,
@@ -133,6 +138,15 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
         h["serve_deadline_miss_rate_noshed"]
     assert h["serve_goodput_2x_vs_1x"] >= 0.9
     assert h["serve_recovery_replay_ms"] == 118.0
+    # multi-replica router keys (ISSUE 7): the N=4 aggregate goodput must
+    # beat the round-robin baseline on both surfaces, the compliant
+    # tenant's p99 fairness ratio stays under the 1.2x isolation bound,
+    # and the failover/drain wall costs ride the headline
+    assert d["serve_agg_goodput_2x_n4"] == h["serve_agg_goodput_2x_n4"]
+    assert h["serve_agg_goodput_2x_n4"] > h["serve_agg_goodput_2x_n4_rr"]
+    assert h["serve_tenant_p99_fairness_ratio"] <= 1.2
+    assert h["serve_failover_replay_ms"] == 145.0
+    assert h["serve_drain_ms"] == 96.0
     # observability keys (ISSUE 6): the tracing-overhead ratio rides the
     # headline and must clear the zero-cost gate; the per-program compile
     # timing dict is sidecar-only (long keys stay out of the tail capture)

@@ -204,6 +204,7 @@ def test_bounded_queue_sheds_with_retry_after_then_resubmit_succeeds(stack):
     assert isinstance(ok, int)
     rej = eng.submit(shed_p, 4)
     assert isinstance(rej, Rejected)
+    assert rej.reason == "queue_full"
     assert rej.retry_after_blocks >= 1 and rej.queue_depth == 1
     assert eng.stats["rejected"] == 1 and len(eng.rejected) == 1
     for _ in range(rej.retry_after_blocks):
@@ -213,6 +214,43 @@ def test_bounded_queue_sheds_with_retry_after_then_resubmit_succeeds(stack):
     comps = {c.request_id: c for c in eng.run()}
     g = lm_c.generate(shed_p[None], max_new_tokens=4)
     assert comps[retry].tokens.tolist() == g.tokens[0].tolist()
+
+
+def test_pool_exhausted_shed_reason_and_retry_from_oldest_decoder(stack):
+    """ISSUE 7 satellite: a bounded-queue shed forced by PAGE-POOL
+    exhaustion (free slots exist, but no pages — previously those free
+    slots excused unbounded queueing and the rejection carried only the
+    queue-drain estimate) is marked ``reason='pool_exhausted'`` and its
+    ``retry_after_blocks`` covers the OLDEST decoding request's remaining
+    budget: the earliest retirement that actually returns pages."""
+    cfg, params, lm_c, lm_p = stack
+    lm_small = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8, 16),
+                        max_batch=3, page_size=PAGE,
+                        page_pool_pages=12).compile()
+    eng = ServeEngine(lm_small, block_steps=K, max_queue=1,
+                      rng=jax.random.key(42))
+    p = _prompts(3, seed=61)
+    r1 = eng.submit(p[0], 12)        # 6 pages: prompt 8 + 12 + K over 4/page
+    eng.step_block()                 # r1 decoding; 3 of 9 pool pages free
+    assert isinstance(r1, int) and eng.slots.count(None) == 2
+    q = eng.submit(p[1], 12)         # needs 6 pages > 3 free: queued
+    assert isinstance(q, int)
+    rej = eng.submit(p[2], 12)       # backlog at bound, pool can't admit
+    assert isinstance(rej, Rejected)
+    assert rej.reason == "pool_exhausted"
+    # oldest decoder r1 delivered 4 of 12 tokens: 8 remaining = 2 blocks
+    expect = -(-(12 - len(eng._out[r1])) // K)
+    assert rej.retry_after_blocks >= expect == 2
+    # contrast: the same shed on the CONTIGUOUS engine is queue-bound
+    eng_c = ServeEngine(lm_c, block_steps=K, max_queue=0,
+                        rng=jax.random.key(42))
+    for i in range(3):
+        eng_c.submit(p[i], 8)
+    eng_c.step_block()
+    rej_c = eng_c.submit(_prompts(1, seed=63)[0], 8)
+    assert isinstance(rej_c, Rejected) and rej_c.reason == "queue_full"
+    eng.run()
+    eng_c.run()
 
 
 def test_deadline_shed_policy_evicts_laxest_deadline(stack):

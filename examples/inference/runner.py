@@ -405,10 +405,20 @@ def cmd_serve(args) -> None:
 
     lm, cfg = build_model(args)
     lm.compile()
+    # host-memory KV tier (paged + prefix cache only): sized in pages from
+    # --host_tier_bytes via the per-page KV footprint; 0 = auto at 2x the
+    # device pool (pool pressure then spills instead of shedding)
+    tier_pages = 0
+    if lm.paged and not args.no_prefix_cache and not args.no_host_tier:
+        if args.host_tier_bytes > 0:
+            tier_pages = max(1, args.host_tier_bytes // lm.kv_page_bytes())
+        else:
+            tier_pages = 2 * lm.config.page_pool_pages
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
                   prefill_chunk_tokens=args.prefill_chunk_tokens,
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
                   block_time_ms=args.block_time_ms,
+                  host_tier_pages=tier_pages,
                   trace=bool(args.trace_out))
 
     def export_observability(engine) -> None:
@@ -442,6 +452,7 @@ def cmd_serve(args) -> None:
         max_new_tokens=args.max_new_tokens,
         mean_interarrival_blocks=args.mean_interarrival,
         shared_prefix_len=args.shared_prefix_len,
+        prefix_families=args.prefix_families,
         long_prompt_frac=args.long_prompt_frac,
         long_prompt_len=args.long_prompt_len,
         ttft_deadline_ms=args.ttft_deadline_ms,
@@ -665,10 +676,25 @@ def main(argv=None) -> None:
         p.add_argument("--no_prefix_cache", action="store_true",
                        help="serve --paged: disable the radix prefix index "
                             "(pages still pooled, no cross-request sharing)")
+        p.add_argument("--host_tier_bytes", type=int, default=0,
+                       help="serve --paged: host-memory KV tier capacity in "
+                            "bytes (cold prefix pages spill there instead "
+                            "of dropping; restored checksum-verified on "
+                            "hit). 0 = auto (2x the device pool); disable "
+                            "with --no_host_tier")
+        p.add_argument("--no_host_tier", action="store_true",
+                       help="serve --paged: disable the host-memory KV tier "
+                            "(pool pressure drops cold pages again)")
         p.add_argument("--shared_prefix_len", type=int, default=0,
                        help="serve: prepend one common random prefix of this "
                             "many tokens to every trace prompt (the "
                             "prefix-cache workload shape)")
+        p.add_argument("--prefix_families", type=int, default=1,
+                       help="serve: rotate through this many DISTINCT "
+                            "shared prefixes in runs of four requests — "
+                            "the idle family's prefix goes cold under pool "
+                            "pressure (the host-tier spill/restore "
+                            "workload shape)")
         p.add_argument("--ttft_deadline_ms", type=float, default=None,
                        help="serve: per-request first-token deadline "
                             "(relative to arrival; converted to the virtual "

@@ -483,6 +483,15 @@ class CausalLM:
                 slab += nbytes
         return {"kv_bytes": actual, "kv_slab_bytes": slab}
 
+    def kv_page_bytes(self) -> int:
+        """Bytes ONE physical KV page occupies across every layer — the
+        host-tier sizing unit (``--host_tier_bytes / kv_page_bytes()`` =
+        tier capacity in pages; the README's HBM-pool + host-tier sizing
+        formula). Paged mode only."""
+        if not self.paged:
+            raise ValueError("kv_page_bytes applies to paged mode only")
+        return self.kv_cache_bytes()["kv_bytes"] // self.config.page_pool_pages
+
     # --- continuous batching (slot-level session API) --------------------
     # The reference reorders sequences into KV-cache slots via its seq_ids
     # machinery (model_wrapper.py:207); here the session object carries the

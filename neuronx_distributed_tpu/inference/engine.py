@@ -72,6 +72,16 @@ Fault tolerance (the overload / fault / crash layer on top):
   budget), and corrupted-page reads recovered by physically re-prefilling
   the affected requests (streams stay bit-identical — the per-request rng
   contract);
+* HOST-MEMORY KV TIER (``host_tier_pages > 0``, paged mode) — pool
+  exhaustion becomes a spill/restore cycle instead of a shed event: cold
+  cache-only prefix pages spill into checksummed host buffers (radix
+  entries retained, marked tiered), a prefix hit on a tiered path restores
+  the pages into fresh device pages before admission, and the admission
+  ladder is spill → restore-budget → re-prefill → shed, making
+  ``PagePoolExhausted`` a last resort. The tier is inclusive, so a
+  corrupted DEVICE page with a live tier copy repairs in place instead of
+  replaying. A failed/corrupt restore (the ``tier`` fault seam) only ever
+  degrades to re-prefill — never a wrong token;
 * SNAPSHOT/RESTORE — ``snapshot()`` at any block boundary serializes the
   scheduler + per-request state (prompt, generated tokens, rng base,
   deadlines, chunk progress) to a JSON-able dict;
@@ -197,6 +207,7 @@ _STAT_KEYS = (
     "chunk_program_calls", "prefill_chunk_tokens_done", "prefill_aborts",
     "cancelled", "rejected", "shed_evictions", "expired",
     "dispatch_retries", "corrupt_page_replays", "restored_requests",
+    "tier_page_repairs",
 )
 
 
@@ -281,6 +292,7 @@ class ServeEngine:
         faults: Optional[Union[FaultPlan, FaultInjector]] = None,
         dispatch_retries: int = 3,
         dispatch_backoff_s: float = 0.001,
+        host_tier_pages: int = 0,
         trace: bool = False,
         tracer: Optional[Tracer] = None,
         metrics: Optional[MetricsRegistry] = None,
@@ -305,6 +317,16 @@ class ServeEngine:
             raise ValueError(f"block_time_ms must be > 0, got {block_time_ms}")
         if dispatch_retries < 0:
             raise ValueError(f"dispatch_retries must be >= 0, got {dispatch_retries}")
+        if host_tier_pages < 0:
+            raise ValueError(
+                f"host_tier_pages must be >= 0, got {host_tier_pages}")
+        if host_tier_pages and not getattr(lm, "paged", False):
+            raise ValueError("host_tier_pages requires a paged CausalLM")
+        if host_tier_pages and not getattr(lm, "prefix_cache", True):
+            raise ValueError(
+                "host_tier_pages requires prefix_cache=True (the tier "
+                "retains radix entries — without the index there is "
+                "nothing to mark tiered)")
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
@@ -359,10 +381,23 @@ class ServeEngine:
         if lm._decode is None:
             lm.compile()
         self.session = lm.start_session()
+        self.host_tier_pages = int(host_tier_pages)
+        if self.host_tier_pages and self.session.paged is not None:
+            # host-memory KV tier (ROADMAP #13): cold cache-only pages spill
+            # into checksummed host buffers instead of dropping; the IO
+            # closures read/write the session's page pools between blocks
+            # (host-side only — no compiled program changes shape)
+            self.session.paged.enable_tier(
+                self.host_tier_pages,
+                self._read_page_bytes, self._write_page_bytes)
         if self._injector is not None and getattr(lm, "paged", False) \
                 and self.session.paged is not None:
             # allocator seam: forced PagePoolExhausted storms
             self.session.paged.allocator.fault_hook = self._injector.on_alloc
+            if self.session.paged.tier is not None:
+                # tier seam: seeded restore failures / corrupted tier bytes
+                self.session.paged.tier.fault_hook = \
+                    self._injector.on_tier_restore
         b = lm.max_batch
         self.queue: deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * b
@@ -644,9 +679,9 @@ class ServeEngine:
 
     def _pool_can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Whether the page pool could cover this admission RIGHT NOW
-        (free pages plus whatever LRU eviction of cache-only prefix pages
-        would return). Contiguous engines always can — their slots ARE the
-        capacity."""
+        (free pages plus whatever reclaim — tier spill of cache-only pages,
+        else LRU drop — would return). Contiguous engines always can —
+        their slots ARE the capacity."""
         if not self.paged:
             return True
         pkv = self.session.paged
@@ -654,21 +689,34 @@ class ServeEngine:
                                 max_new_tokens + self.block_steps)
         free = pkv.allocator.available()
         if free < need and pkv.prefix is not None:
-            free += pkv.prefix.evictable_pages()
+            free += pkv.prefix.reclaimable_pages()
         return free >= need
 
-    def _pool_retry_after(self) -> int:
-        """Pool-pressure retry estimate: the OLDEST decoding request's
-        remaining token budget in blocks — the earliest retirement that
-        returns pages to the pool (a shed client resubmitting after that
-        many blocks meets a drained-enough pool)."""
+    def _pool_retry_after(self, req: Optional[Request] = None) -> int:
+        """Pool-pressure retry estimate, two branches (ISSUE 8 satellite):
+
+        * a SPILL could free enough pages for ``req`` — the shortfall is
+          cold cache-resident pages the tier can absorb, which the very
+          next admission attempt reclaims: retry after ~1 block (spill
+          latency), NOT the oldest stream's remaining budget;
+        * otherwise the OLDEST decoding request's remaining token budget in
+          blocks — the earliest retirement that returns pages to the pool.
+        """
+        pkv = self.session.paged if self.paged else None
+        if (req is not None and pkv is not None and pkv.prefix is not None
+                and pkv.tier is not None):
+            need = pkv.pages_needed(req.prompt.size,
+                                    req.max_new_tokens + self.block_steps)
+            if (pkv.allocator.available()
+                    + pkv.prefix.spillable_pages()) >= need:
+                return 1
         oldest: Optional[Request] = None
-        for slot, req in enumerate(self.slots):
-            if req is None or slot in self._prefilling:
+        for slot, req_ in enumerate(self.slots):
+            if req_ is None or slot in self._prefilling:
                 continue
-            if oldest is None or ((req.start_block or 0)
+            if oldest is None or ((req_.start_block or 0)
                                   < (oldest.start_block or 0)):
-                oldest = req
+                oldest = req_
         if oldest is None:
             return 1
         remaining = (oldest.max_new_tokens
@@ -696,7 +744,7 @@ class ServeEngine:
                 self.stats["shed_evictions"] += 1
         retry = self._retry_after()
         if pool_bound:
-            retry = max(retry, self._pool_retry_after())
+            retry = max(retry, self._pool_retry_after(victim))
         rej = Rejected(request_id=victim.request_id,
                        retry_after_blocks=retry,
                        queue_depth=sum(1 for r in self.queue
@@ -1276,6 +1324,35 @@ class ServeEngine:
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
 
+    def _read_page_bytes(self, page: int) -> Dict[str, np.ndarray]:
+        """Host copy of one physical page's K/V bytes across every layer —
+        the tier's spill read ({cache-leaf path: (L, page_size, kv, hd)
+        array}). Runs between blocks only; device programs never see it."""
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.session.cache)[0]:
+            p = jax.tree_util.keystr(path)
+            if (p.endswith("['cached_key']")
+                    or p.endswith("['cached_value']")):
+                out[p] = np.asarray(leaf[:, int(page)])
+        return out
+
+    def _write_page_bytes(self, page: int,
+                          data: Dict[str, np.ndarray]) -> None:
+        """Write host bytes back into physical page ``page`` of every K/V
+        pool leaf — the tier's restore/repair write (the functional update
+        replaces the session cache between blocks, same discipline as
+        ``_set_block_tables``)."""
+        def fix(path, leaf):
+            p = jax.tree_util.keystr(path)
+            if p in data:
+                return leaf.at[:, int(page)].set(
+                    jnp.asarray(data[p], leaf.dtype))
+            return leaf
+
+        self.session.cache = jax.tree_util.tree_map_with_path(
+            fix, self.session.cache)
+
     def _corrupt_page_bytes(self, pages: List[int]) -> None:
         """Physically garble the K/V pool bytes of ``pages`` in every layer.
         The injected fault is REAL — the recovery replay is thereby proven
@@ -1303,11 +1380,14 @@ class ServeEngine:
 
     def _handle_corrupt_pages(self, pages: List[int]) -> None:
         """Corrupted-page recovery, in dependency order: garble the bytes
-        (make the fault real), invalidate the pages from the prefix index
-        (no future sharer may splice them in), unwind any mid-prefill
-        admission holding one (it restarts from the queue), then re-prefill
-        every decoding request reading through one — their streams resume
-        bit-identical (per-request rng)."""
+        (make the fault real), REPAIR in place from the host tier where an
+        inclusive checksum-verified copy exists (the subtree stays valid,
+        no stream replays — restore beats re-prefill), invalidate the
+        remaining pages from the prefix index (no future sharer may splice
+        them in), unwind any mid-prefill admission holding one (it restarts
+        from the queue), then re-prefill every decoding request reading
+        through one — their streams resume bit-identical (per-request
+        rng)."""
         pkv = self.session.paged
         bad = {int(p) for p in pages}
         if self.tracer.enabled:
@@ -1316,6 +1396,14 @@ class ServeEngine:
                 block=self.blocks,
                 args={"pages": sorted(bad)})
         self._corrupt_page_bytes(sorted(bad))
+        if pkv.tier is not None:
+            repaired = {p for p in sorted(bad)
+                        if pkv.repair_page_from_tier(p)}
+            if repaired:
+                self.stats["tier_page_repairs"] += len(repaired)
+                bad -= repaired
+            if not bad:
+                return
         if pkv.prefix is not None:
             pkv.prefix.invalidate_pages(sorted(bad))
         for slot, st in list(self._prefilling.items()):
@@ -1451,8 +1539,13 @@ class ServeEngine:
                 "shed_policy": self.shed_policy,
                 "block_time_ms": self.block_time_ms,
                 "dispatch_retries": self.dispatch_retries,
+                "host_tier_pages": self.host_tier_pages,
                 "paged": self.paged,
             },
+            # tier CONTENT is deliberately dropped (host buffers die with
+            # the process, exactly like device pages); the knob above makes
+            # the restored engine re-enable an empty tier, and the replay
+            # path re-prefills — bit-identical either way (test-pinned)
             "requests": reqs,
         }
 
@@ -1484,6 +1577,10 @@ class ServeEngine:
             raise ValueError(f"unknown snapshot version {snap.get('version')}")
         cfg = dict(snap.get("config", {}))
         cfg.pop("paged", None)   # informational: the lm decides the mode
+        if not getattr(lm, "paged", False):
+            # restoring a tiered snapshot into a contiguous oracle: the
+            # tier knob has no meaning there (streams are identical anyway)
+            cfg.pop("host_tier_pages", None)
         cfg.update(overrides)
         rng = jax.random.wrap_key_data(
             jnp.asarray(snap["rng"], jnp.uint32))
@@ -1567,11 +1664,15 @@ class ServeEngine:
             self.tracer.counter("queue_depth", (self.lane, "queue"), depth,
                                 block=self.blocks)
         if self.paged and self.session.paged is not None:
-            in_use = self.session.paged.allocator.in_use()
+            pkv = self.session.paged
+            in_use = pkv.allocator.in_use()
             self._m_pool.set(in_use)
             if tr_on:
                 self.tracer.counter("pages_in_use", ("cache", "pool"),
                                     in_use, block=self.blocks)
+                if pkv.tier is not None:
+                    self.tracer.counter("tier_pages", ("cache", "tier"),
+                                        pkv.tier_pages(), block=self.blocks)
 
     def _fetch(self, arr) -> np.ndarray:
         """The block's host fetch, as an observable span: device->host copy
@@ -1758,6 +1859,7 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     mean_interarrival_blocks: float = 0.5,
                     eos_token_id: Optional[int] = None,
                     shared_prefix_len: int = 0,
+                    prefix_families: int = 1,
                     long_prompt_frac: float = 0.0,
                     long_prompt_len: int = 0,
                     ttft_deadline_ms: Optional[float] = None,
@@ -1769,9 +1871,14 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     exponential inter-arrivals, prompt lengths cycled through
     ``prompt_lens`` — the multi-tenant workload shape the serving bench and
     the ``runner.py serve`` entrypoint replay. ``shared_prefix_len > 0``
-    prepends ONE common random prefix of that many tokens to every prompt
+    prepends a common random prefix of that many tokens to every prompt
     (the system-prompt / few-shot-header workload shape the paged engine's
-    prefix cache exists for; prompt_lens then size the per-request tail).
+    prefix cache exists for; prompt_lens then size the per-request tail);
+    ``prefix_families > 1`` rotates through that many DISTINCT prefixes in
+    runs of four consecutive requests (A A A A B B B B A ...) — the
+    working-set-larger-than-the-pool workload the host tier exists for:
+    the idle family's prefix goes cold, spills, and must restore (or
+    re-prefill) when its run comes around again.
 
     ``long_prompt_frac > 0`` makes the prompt-length distribution heavy-
     tailed: every ``round(1/frac)``-th request (never the first, so decode
@@ -1793,9 +1900,13 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         raise ValueError(f"tenants must be >= 0, got {tenants}")
     if tenant_skew < 0:
         raise ValueError(f"tenant_skew must be >= 0, got {tenant_skew}")
+    if prefix_families < 1:
+        raise ValueError(f"prefix_families must be >= 1, got {prefix_families}")
     long_every = round(1 / long_prompt_frac) if long_prompt_frac > 0 else 0
     rs = np.random.RandomState(seed)
-    prefix = rs.randint(1, vocab_size, (shared_prefix_len,)).astype(np.int32)
+    prefixes = [rs.randint(1, vocab_size,
+                           (shared_prefix_len,)).astype(np.int32)
+                for _ in range(prefix_families)]
     tenant_p = None
     if tenants:
         w = 1.0 / np.arange(1, tenants + 1, dtype=np.float64) ** tenant_skew
@@ -1810,6 +1921,7 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         tail = rs.randint(1, vocab_size, (s,)).astype(np.int32)
         if tenant_p is not None:
             trace_tenant = f"t{int(rs.choice(tenants, p=tenant_p))}"
+        prefix = prefixes[(i // 4) % prefix_families]
         trace.append({
             "prompt": np.concatenate([prefix, tail]) if shared_prefix_len else tail,
             "max_new_tokens": max_new_tokens,
@@ -2028,4 +2140,21 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             "kv_slab_hbm_bytes": kv["kv_slab_bytes"],
             "kv_hbm_vs_slab": round(kv["kv_bytes"] / kv["kv_slab_bytes"], 3),
         })
+        if pkv.tier is not None:
+            # host-tier surface: the spill/restore/repair cycle plus what
+            # is resident right now — the "pool pressure became latency,
+            # not sheds" evidence
+            report.update({
+                "host_tier_pages": pkv.tier.max_pages,
+                "tier_pages_resident": pkv.tier_pages(),
+                "tier_bytes_resident": pkv.tier_bytes(),
+                "tier_spilled_pages": pkv.stats["tier_spilled_pages"],
+                "tier_restored_pages": pkv.stats["tier_restored_pages"],
+                "tier_hits": pkv.stats["tier_hits"],
+                "tier_restore_failures": pkv.stats["tier_restore_failures"],
+                "tier_repaired_pages": pkv.stats["tier_repaired_pages"],
+                "tier_restore_ms_p99": (
+                    round(float(np.percentile(pkv._restore_ms, 99)), 3)
+                    if pkv._restore_ms else None),
+            })
     return report

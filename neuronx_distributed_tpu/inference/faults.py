@@ -34,6 +34,14 @@ in the same order, so "replay the storm" is a one-line reproducer:
   or the replica's last snapshot — streams stay bit-identical because
   token t of request r draws ``fold_in(fold_in(base, r), t)`` regardless
   of which replica serves it.
+* **tier** (``FaultInjector.on_tier_restore``) — per host-tier page read,
+  the restore may FAIL outright (``tier_restore_fail_prob`` — an IO error:
+  the entry is dropped, the admission re-prefills the suffix) or the tier
+  bytes may be physically garbled first (``tier_corrupt_prob`` — the
+  per-page checksum catches it, the poisoned copy is dropped, and again the
+  path degrades to re-prefill). Either way the stream stays bit-identical:
+  a tier fault is a LATENCY event, never a wrong token — which the tier
+  chaos tests assert.
 
 Decisions are drawn from PER-SEAM ``RandomState`` streams (seed folded with
 the seam name), so adding draws at one seam never perturbs another — the
@@ -74,13 +82,20 @@ class FaultPlan:
     corrupt_page_prob: float = 0.0
     replica_crash_prob: float = 0.0
     max_replica_crashes: int = 1
+    tier_restore_fail_prob: float = 0.0
+    tier_corrupt_prob: float = 0.0
 
     def __post_init__(self):
         for name in ("pool_exhaust_prob", "dispatch_fail_prob",
-                     "corrupt_page_prob", "replica_crash_prob"):
+                     "corrupt_page_prob", "replica_crash_prob",
+                     "tier_restore_fail_prob", "tier_corrupt_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.tier_restore_fail_prob + self.tier_corrupt_prob > 1.0:
+            raise ValueError(
+                "tier_restore_fail_prob + tier_corrupt_prob must be <= 1 "
+                "(one verdict per restore)")
         if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
             raise ValueError("storm lengths must be >= 1")
         if self.max_replica_crashes < 0:
@@ -115,13 +130,14 @@ class FaultInjector:
         self._rs = {
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
-            for seam in ("alloc", "dispatch", "corrupt", "replica")
+            for seam in ("alloc", "dispatch", "corrupt", "replica", "tier")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
         self._replica_crashes_done = 0
         self.stats = {"alloc_faults": 0, "dispatch_faults": 0,
-                      "pages_corrupted": 0, "replica_crashes": 0}
+                      "pages_corrupted": 0, "replica_crashes": 0,
+                      "tier_restore_faults": 0, "tier_corruptions": 0}
 
     # --- allocator seam --------------------------------------------------
 
@@ -179,6 +195,28 @@ class FaultInjector:
             self._replica_crashes_done += 1
             self.stats["replica_crashes"] += 1
             return victim
+        return None
+
+    # --- tier seam -------------------------------------------------------
+
+    def on_tier_restore(self) -> Optional[str]:
+        """Called by ``HostPageTier.get`` before each restore/repair read:
+        one draw decides the verdict — ``'fail'`` (read error: the tier
+        drops the entry and raises), ``'corrupt'`` (the tier garbles the
+        entry's host bytes; the checksum then catches it), or None (clean
+        read). One draw per read keeps the seam's schedule independent of
+        which verdict fired."""
+        frp = self.plan.tier_restore_fail_prob
+        tcp = self.plan.tier_corrupt_prob
+        if not (frp or tcp):
+            return None
+        u = self._rs["tier"].random_sample()
+        if u < frp:
+            self.stats["tier_restore_faults"] += 1
+            return "fail"
+        if u < frp + tcp:
+            self.stats["tier_corruptions"] += 1
+            return "corrupt"
         return None
 
     # --- corruption seam -------------------------------------------------

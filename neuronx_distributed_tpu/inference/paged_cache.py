@@ -27,6 +27,20 @@ Host layout (this module):
 * :class:`PagedKVCache` — per-session bookkeeping: block tables, per-slot
   scratch pages, the plan/commit/rollback/release lifecycle that
   ``CausalLM.insert``/``retire`` drive.
+* :class:`HostPageTier` — the host-memory KV tier (Mooncake-style tiering;
+  CacheGen's "restore beats recompute" economics): under pool pressure,
+  cold cache-only prefix pages are SPILLED — their K/V bytes copied into
+  pinned host buffers with a per-page checksum, the radix entry retained
+  and marked tiered — instead of dropped. A later prefix hit on a tiered
+  path RESTORES the bytes into fresh device pages (checksum-verified)
+  before admission, so the prefix cache is host-RAM-bounded instead of
+  HBM-bounded. The degradation ladder under pressure is
+  spill → restore-what-fits → re-prefill → shed: a restore that fails
+  (seeded fault, corrupted tier bytes caught by checksum) invalidates the
+  subtree and falls back to re-prefilling the suffix — never a wrong
+  token. The tier is INCLUSIVE: a restored page keeps its host copy, which
+  doubles as a recovery source when the DEVICE page is later corrupted
+  (repair-in-place instead of a replay re-prefill).
 
 Sharing is copy-on-write by construction rather than by copying: shared
 pages cover only FULL pages strictly below a request's private region (the
@@ -39,8 +53,10 @@ therefore immutable until its refcount drains to zero.
 from __future__ import annotations
 
 import dataclasses
+import time
+import zlib
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,6 +65,105 @@ class PagePoolExhausted(RuntimeError):
     """Not enough free pages for an admission, even after evicting
     cache-only prefix pages. The scheduler defers the request (pages free up
     as in-flight requests retire)."""
+
+
+class TierRestoreError(RuntimeError):
+    """A host-tier page read failed (injected IO fault). The entry is
+    dropped and admission degrades to re-prefilling the suffix."""
+
+
+class TierCorruption(RuntimeError):
+    """A host-tier page's bytes no longer match its stored checksum — the
+    copy is poison and is dropped; admission re-prefills instead. The
+    checksum is what turns 'corrupted tier bytes' from a wrong-token hazard
+    into a latency event."""
+
+
+class HostPageTier:
+    """Host-memory store of spilled KV pages: one entry per radix node,
+    holding the page's per-leaf K/V bytes (contiguous host copies — the
+    pinned-buffer analogue on this harness) plus a crc32 checksum computed
+    at spill time and re-verified on every read. Capacity is bounded in
+    PAGES; inserting past it drops the least-recently-used entries (the
+    owning index is told via :meth:`put`'s return so it can clear the dead
+    radix entries). ``fault_hook`` is the ``tier`` seam of
+    ``inference/faults.py``: consulted per :meth:`get`, it may force a
+    restore failure or garble the entry's bytes (which the checksum then
+    catches) — both deterministic, both ending in re-prefill."""
+
+    def __init__(self, max_pages: int):
+        if max_pages < 1:
+            raise ValueError(f"host tier needs >= 1 page, got {max_pages}")
+        self.max_pages = int(max_pages)
+        self._entries: Dict[int, dict] = {}
+        self._next = 0
+        self._clock = 0
+        self.fault_hook: Optional[Callable[[], Optional[str]]] = None
+        self.stats = {"puts": 0, "gets": 0, "restore_failures": 0,
+                      "checksum_failures": 0, "lru_drops": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def bytes_used(self) -> int:
+        return sum(e["nbytes"] for e in self._entries.values())
+
+    @staticmethod
+    def _crc(data: Dict[str, np.ndarray]) -> int:
+        crc = 0
+        for k in sorted(data):
+            crc = zlib.crc32(np.ascontiguousarray(data[k]).tobytes(), crc)
+        return crc
+
+    def put(self, data: Dict[str, np.ndarray]) -> Tuple[int, List[int]]:
+        """Store one page's leaf bytes; returns (tier id, LRU-dropped tier
+        ids) — the caller must clear the dropped ids' radix entries."""
+        data = {k: np.ascontiguousarray(v) for k, v in data.items()}
+        tid = self._next
+        self._next += 1
+        self._clock += 1
+        self._entries[tid] = {
+            "data": data, "crc": self._crc(data),
+            "nbytes": sum(v.nbytes for v in data.values()),
+            "last_used": self._clock,
+        }
+        self.stats["puts"] += 1
+        evicted: List[int] = []
+        while len(self._entries) > self.max_pages:
+            victim = min((t for t in self._entries if t != tid),
+                         key=lambda t: self._entries[t]["last_used"])
+            del self._entries[victim]
+            evicted.append(victim)
+            self.stats["lru_drops"] += 1
+        return tid, evicted
+
+    def get(self, tid: int) -> Dict[str, np.ndarray]:
+        """Checksum-verified read. Raises :class:`TierRestoreError` /
+        :class:`TierCorruption` (entry dropped either way — a copy that
+        failed once must never be trusted again)."""
+        entry = self._entries[tid]
+        self._clock += 1
+        entry["last_used"] = self._clock
+        self.stats["gets"] += 1
+        verdict = self.fault_hook() if self.fault_hook is not None else None
+        if verdict == "fail":
+            del self._entries[tid]
+            self.stats["restore_failures"] += 1
+            raise TierRestoreError(f"injected tier read failure (tid {tid})")
+        if verdict == "corrupt":
+            # physically garble the host copy — the checksum must catch it
+            first = next(iter(sorted(entry["data"])))
+            entry["data"][first] = entry["data"][first].copy()
+            entry["data"][first].view(np.uint8).reshape(-1)[0] ^= 0xFF
+        if self._crc(entry["data"]) != entry["crc"]:
+            del self._entries[tid]
+            self.stats["checksum_failures"] += 1
+            raise TierCorruption(f"tier page {tid} failed checksum")
+        return entry["data"]
+
+    def drop(self, tid: Optional[int]) -> None:
+        if tid is not None:
+            self._entries.pop(tid, None)
 
 
 class PageAllocator:
@@ -106,7 +221,15 @@ class PageAllocator:
 
 
 class _Node:
-    __slots__ = ("children", "page", "parent", "key", "last_used")
+    """One cached prompt page. Residency states: ``page >= 0`` — device-
+    resident (holds one allocator refcount); ``page < 0`` with a
+    ``tier_id`` — spilled to the host tier; ``page < 0`` and no tier id —
+    DEAD (dropped from the trie; the marker keeps a stale reference held by
+    an in-flight admission plan from resurrecting a freed page). A node may
+    be BOTH device-resident and tiered (inclusive tier: a restored page
+    keeps its host copy as a corruption-repair source)."""
+
+    __slots__ = ("children", "page", "parent", "key", "last_used", "tier_id")
 
     def __init__(self, key, page, parent):
         self.children: Dict[tuple, _Node] = {}
@@ -114,12 +237,14 @@ class _Node:
         self.page = page
         self.parent = parent
         self.last_used = 0
+        self.tier_id: Optional[int] = None
 
 
 class RadixPrefixIndex:
-    """Page-granular prompt prefix trie. Each cached page holds one
-    allocator refcount; eviction (LRU over leaves) drops that hold so pages
-    unreferenced by any active slot return to the free list."""
+    """Page-granular prompt prefix trie. Each cached DEVICE page holds one
+    allocator refcount; under pool pressure cache-only pages are spilled to
+    the host tier when one is attached (entry retained, marked tiered) and
+    dropped otherwise (LRU over leaves)."""
 
     def __init__(self, page_size: int, allocator: PageAllocator):
         self.page_size = int(page_size)
@@ -127,60 +252,159 @@ class RadixPrefixIndex:
         self.root = _Node(None, -1, None)
         self._clock = 0
         self.cached_pages = 0
+        # host tier (attach_tier): None keeps the drop-on-evict behaviour
+        self.tier: Optional[HostPageTier] = None
+        self._read_page = None      # device page -> {leaf path: np bytes}
+        self._tier_nodes: Dict[int, _Node] = {}
+
+    def attach_tier(self, tier: HostPageTier, read_page) -> None:
+        self.tier = tier
+        self._read_page = read_page
 
     def lookup(self, tokens: Sequence[int]) -> List[int]:
-        """Physical page ids of the longest cached page-aligned prefix of
-        ``tokens`` (possibly empty), LRU-touched along the path."""
+        """Physical page ids of the longest DEVICE-RESIDENT cached
+        page-aligned prefix of ``tokens`` (possibly empty), LRU-touched
+        along the path. Stops at the first tiered entry — admission paths
+        that can restore walk :meth:`lookup_nodes` instead."""
+        pages = []
+        for node in self.lookup_nodes(tokens):
+            if node.page < 0:
+                break
+            pages.append(node.page)
+        return pages
+
+    def lookup_nodes(self, tokens: Sequence[int]) -> List[_Node]:
+        """Trie nodes of the longest cached page-aligned prefix — device-
+        resident AND tiered entries — LRU-touched along the path. The
+        tier-aware admission walk: the caller restores tiered nodes (or
+        degrades to a shorter prefix)."""
         ps = self.page_size
         self._clock += 1
-        node, pages = self.root, []
+        node, out = self.root, []
         for i in range(len(tokens) // ps):
             child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
             if child is None:
                 break
             child.last_used = self._clock
-            pages.append(child.page)
+            out.append(child)
             node = child
-        return pages
+        return out
 
     def peek(self, tokens: Sequence[int]) -> List[int]:
-        """Read-only :meth:`lookup`: physical page ids of the longest cached
-        page-aligned prefix WITHOUT touching the LRU clock or taking any
-        hold — the Router's prefix-affinity probe (it peeks every replica
-        per placement; a probe that refreshed LRU stamps would let routing
-        queries keep dead prefixes resident)."""
+        """Read-only :meth:`lookup_nodes`: page ids of the longest cached
+        page-aligned prefix WITHOUT touching the LRU clock, taking any hold,
+        or triggering a tier restore — the Router's prefix-affinity probe
+        (it peeks every replica per placement; a probe that refreshed LRU
+        stamps would let routing queries keep dead prefixes resident).
+        Tiered entries report as ``-1`` page ids: a tiered prefix counts as
+        a hit (restore is ~a block, re-prefill is the whole suffix), so
+        placement prefers replicas whose tier holds the prefix."""
         ps = self.page_size
         node, pages = self.root, []
         for i in range(len(tokens) // ps):
             child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
             if child is None:
                 break
-            pages.append(child.page)
+            pages.append(child.page if child.page >= 0 else -1)
             node = child
         return pages
 
     def evictable_pages(self) -> int:
-        """Pages LRU eviction could return to the free list right now:
-        cache-only (refcount 1) nodes whose whole subtree is also cache-only
-        (eviction frees leaves first, so a cache-only node above a slot-held
-        page stays pinned). The scheduler's pool-feasibility probe."""
+        """DEVICE pages LRU eviction could return to the free list right
+        now: cache-only (refcount 1) nodes whose whole subtree is also
+        evictable (eviction frees leaves first, so a cache-only node above a
+        slot-held page stays pinned). Tiered entries hold no device page —
+        they count 0 and are transparent (they never pin an ancestor). The
+        scheduler's pool-feasibility probe."""
         def count(node) -> Tuple[int, bool]:
             total, all_ev = 0, True
             for c in node.children.values():
                 t, ev = count(c)
                 total += t
                 all_ev = all_ev and ev
+            if node.page < 0:
+                return total, all_ev
             if all_ev and self.allocator.refcount[node.page] == 1:
                 return total + 1, True
             return total, False
 
         return sum(count(c)[0] for c in self.root.children.values())
 
+    def spillable_pages(self) -> int:
+        """DEVICE pages a spill could move to the host tier right now: ANY
+        cache-only node, leaf or interior — spilling keeps the trie entry,
+        so interior nodes are fair game (eviction can only drop leaves).
+        0 without a tier."""
+        if self.tier is None:
+            return 0
+        return sum(1 for n in self._iter_nodes()
+                   if n.page >= 0 and self.allocator.refcount[n.page] == 1)
+
+    def reclaimable_pages(self) -> int:
+        """Device pages :meth:`reclaim` could free right now — the
+        scheduler's feasibility probe: spillable (tier attached) since
+        spillable ⊇ evictable, else evictable."""
+        return (self.spillable_pages() if self.tier is not None
+                else self.evictable_pages())
+
+    def spill(self, n_pages: int) -> int:
+        """Spill up to ``n_pages`` cold cache-only DEVICE pages into the
+        host tier (LRU order, interior nodes included): bytes copied out
+        with a checksum, the device page released to the free list, the
+        radix entry retained and marked tiered. A node that already holds an
+        (inclusive) tier copy skips the byte copy. Returns pages freed."""
+        if self.tier is None or self._read_page is None:
+            return 0
+        freed = 0
+        while freed < n_pages:
+            victims = [n for n in self._iter_nodes()
+                       if n.page >= 0
+                       and self.allocator.refcount[n.page] == 1]
+            if not victims:
+                return freed
+            node = min(victims, key=lambda n: n.last_used)
+            if node.tier_id is None:
+                tid, dropped = self.tier.put(self._read_page(node.page))
+                node.tier_id = tid
+                self._tier_nodes[tid] = node
+                for d in dropped:
+                    self._on_tier_drop(d)
+            if node.page >= 0:
+                freed += len(self.allocator.release([node.page]))
+                node.page = -1
+            else:
+                # a tier-LRU cascade dropped an ancestor whose subtree
+                # included this node — its device page was freed there
+                freed += 1
+        return freed
+
+    def _on_tier_drop(self, tid: int) -> None:
+        """The tier LRU-dropped ``tid``: clear the marker; a tiered-ONLY
+        node loses its last copy and leaves the trie with its subtree."""
+        node = self._tier_nodes.pop(tid, None)
+        if node is None:
+            return
+        node.tier_id = None
+        if node.page < 0 and node.key in getattr(node.parent, "children", {}):
+            self._drop_subtree(node)
+            del node.parent.children[node.key]
+
+    def node_for_page(self, page: int) -> Optional[_Node]:
+        """The trie node currently holding device page ``page`` (None when
+        the page is request-private) — the corruption-repair probe."""
+        for n in self._iter_nodes():
+            if n.page == int(page):
+                return n
+        return None
+
     def register(self, tokens: Sequence[int], pages: Sequence[int]) -> None:
         """Record prompt pages AFTER their K/V were written. A page whose
-        path already exists keeps the existing entry (the new physical copy
-        stays request-private and is freed at retire); new entries take one
-        cache refcount hold."""
+        path already exists as a DEVICE entry keeps that entry (the new
+        physical copy stays request-private and is freed at retire); a
+        TIERED entry re-adopts the freshly written device page (identical
+        content — the re-prefill just repopulated device residency, so the
+        next hit skips the restore); new entries take one cache refcount
+        hold."""
         ps = self.page_size
         if len(pages) * ps > len(tokens):
             raise ValueError("register: pages exceed token coverage")
@@ -194,31 +418,64 @@ class RadixPrefixIndex:
                 node.children[key] = child
                 self.allocator.retain([int(page)])
                 self.cached_pages += 1
+            elif child.page < 0:
+                child.page = int(page)
+                self.allocator.retain([int(page)])
             child.last_used = self._clock
             node = child
 
     def evict(self, n_pages: int) -> int:
-        """Evict LRU leaf pages whose only hold is the cache's, until
-        ``n_pages`` pages returned to the free list (or no candidate is
-        left). Returns the number actually freed."""
+        """Evict LRU DEVICE-resident leaf entries whose only hold is the
+        cache's, until ``n_pages`` pages returned to the free list (or no
+        candidate is left). Tiered-only leaves are never victims here —
+        they hold no device page, so dropping them frees nothing and would
+        destroy exactly the copies the tier exists to keep (use
+        :meth:`drop_tiered` for a full drain). Returns the number of device
+        pages actually freed."""
         freed = 0
         while freed < n_pages:
             leaves = [c for c in self._iter_nodes()
-                      if not c.children and self.allocator.refcount[c.page] == 1]
+                      if not c.children and c.page >= 0
+                      and self.allocator.refcount[c.page] == 1]
             if not leaves:
                 return freed
             victim = min(leaves, key=lambda c: c.last_used)
             del victim.parent.children[victim.key]
-            self.cached_pages -= 1
-            freed += len(self.allocator.release([victim.page]))
+            freed += self._drop_subtree(victim)
         return freed
+
+    def drop_tiered(self) -> int:
+        """Drop every tiered-ONLY subtree (host copies included) — the
+        full-drain complement to ``evict(10**6)``: call drop_tiered FIRST
+        (a tiered-only leaf shields its device ancestors from leaf-first
+        eviction), then evict — after both, the trie, the allocator's
+        cache holds, AND the tier must all be empty, the no-leak invariant
+        the chaos tests pin. Returns entries dropped."""
+        dropped = 0
+
+        def scrub(node):
+            nonlocal dropped
+            for key, child in list(node.children.items()):
+                if child.page < 0:
+                    before = self.cached_pages
+                    self._drop_subtree(child)
+                    dropped += before - self.cached_pages
+                    del node.children[key]
+                else:
+                    scrub(child)
+
+        scrub(self.root)
+        return dropped
 
     def invalidate_pages(self, pages: Sequence[int]) -> int:
         """Drop every trie entry whose physical page is in ``pages`` (a
         corrupted-page report), INCLUDING its whole subtree — a descendant's
         prefix runs through the bad page, so a sharer admitted against it
         would splice corrupted K/V into its context. Each removed node's
-        cache hold is released. Returns the number of entries removed."""
+        cache hold is released and its tier copy dropped (a tier copy of a
+        page just declared corrupt may itself be suspect — the repair path
+        that trusts one verifies the checksum FIRST and is the only reader
+        that may). Returns the number of entries removed."""
         bad = {int(p) for p in pages}
         removed = 0
 
@@ -226,7 +483,9 @@ class RadixPrefixIndex:
             nonlocal removed
             for key, child in list(node.children.items()):
                 if child.page in bad:
-                    removed += self._drop_subtree(child)
+                    before = self.cached_pages
+                    self._drop_subtree(child)
+                    removed += before - self.cached_pages
                     del node.children[key]
                 else:
                     scrub(child)
@@ -235,12 +494,23 @@ class RadixPrefixIndex:
         return removed
 
     def _drop_subtree(self, node) -> int:
-        n = 1
+        """Remove ``node`` and its descendants from all accounting: device
+        holds released, tier copies dropped, DEAD-marked (page = -1, no
+        tier id) so a stale reference held by an in-flight admission plan
+        can never resurrect a freed page. Returns device pages freed."""
+        freed = 0
         self.cached_pages -= 1
-        self.allocator.release([node.page])
+        if node.page >= 0:
+            freed += len(self.allocator.release([node.page]))
+        if node.tier_id is not None:
+            if self.tier is not None:
+                self.tier.drop(node.tier_id)
+            self._tier_nodes.pop(node.tier_id, None)
+        node.page = -1
+        node.tier_id = None
         for child in node.children.values():
-            n += self._drop_subtree(child)
-        return n
+            freed += self._drop_subtree(child)
+        return freed
 
     def _iter_nodes(self):
         stack = list(self.root.children.values())
@@ -312,24 +582,185 @@ class PagedKVCache:
         self._slot_pages: Dict[int, List[int]] = {}
         self.stats = {"prefix_queries": 0, "prefix_hits": 0,
                       "prefix_hit_tokens": 0, "evicted_pages": 0,
-                      "pages_in_use_peak": 0}
+                      "pages_in_use_peak": 0,
+                      # host-tier surface (zeros with the tier disabled)
+                      "tier_spilled_pages": 0, "tier_restored_pages": 0,
+                      "tier_hits": 0, "tier_restore_failures": 0,
+                      "tier_repaired_pages": 0}
+        # host-memory tier (enable_tier): spilled cold prefix pages +
+        # device read/write callbacks into the session's page pools
+        self.tier: Optional[HostPageTier] = None
+        self._write_page = None
+        self._restore_ms: List[float] = []
         # observability (attach_observability): cache-lane trace events +
         # prefix-hit-length histogram; None => zero-cost no-ops
         self._tracer = None
         self._m_prefix = None
+        self._m_restore = None
+        self._m_tier_bytes = None
+
+    # --- host tier -------------------------------------------------------
+
+    def enable_tier(self, max_pages: int, read_page, write_page) -> None:
+        """Attach a host-memory tier of ``max_pages`` pages. ``read_page``
+        (physical page -> {leaf path: host bytes}) and ``write_page``
+        (physical page, bytes -> device write) are the session-cache IO the
+        spill/restore cycle runs through — the engine supplies closures
+        over its session. Requires the prefix index (tiering without a
+        radix entry to retain would be an unreachable copy)."""
+        if self.prefix is None:
+            raise ValueError("host tier requires prefix_cache=True")
+        self.tier = HostPageTier(max_pages)
+        self._write_page = write_page
+        self.prefix.attach_tier(self.tier, read_page)
+
+    def tier_pages(self) -> int:
+        return 0 if self.tier is None else len(self.tier)
+
+    def tier_bytes(self) -> int:
+        return 0 if self.tier is None else self.tier.bytes_used()
+
+    def _reclaim(self, n: int) -> int:
+        """Free ``n`` device pages by the ladder (spill → evict-drop),
+        keeping the legacy 'evicted_pages' stat to dropped entries only."""
+        if self.prefix is None:
+            return 0
+        spilled = self.prefix.spill(n)
+        if spilled:
+            self.stats["tier_spilled_pages"] += spilled
+            self._note_tier("tier:spill", pages=spilled)
+        dropped = 0
+        if spilled < n:
+            dropped = self.prefix.evict(n - spilled)
+            self.stats["evicted_pages"] += dropped
+            self._note_evict(dropped)
+        return spilled + dropped
+
+    def _alloc_with_reclaim(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, reclaiming (spill-then-evict) from the
+        prefix cache on a miss. None only when the pool genuinely cannot
+        cover — the caller degrades (shorter restored prefix) or raises
+        :class:`PagePoolExhausted` (shed, the last resort)."""
+        pages = self.allocator.alloc(n)
+        if pages is None and self.prefix is not None:
+            self._reclaim(n - self.allocator.available())
+            pages = self.allocator.alloc(n)
+        return pages
+
+    def _restore_node(self, node) -> Optional[int]:
+        """Restore one tiered radix entry into a fresh device page:
+        checksum-verified host read, page allocated (reclaim allowed),
+        bytes written back, entry re-marked device-resident (the alloc's
+        refcount-1 IS the cache hold the spill released). Returns the page
+        id, or None to degrade — restore budget exhausted (no page even
+        after reclaim) leaves the entry tiered for a later hit; a FAILED or
+        corrupt read drops the entry's subtree so the admission re-prefills
+        (never a wrong token)."""
+        if self.tier is None or node.tier_id is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            data = self.tier.get(node.tier_id)
+        except (TierRestoreError, TierCorruption) as e:
+            self.stats["tier_restore_failures"] += 1
+            self._note_tier("tier:corrupt", error=type(e).__name__)
+            # the tier already dropped the entry; scrub the trie subtree
+            self.prefix._tier_nodes.pop(node.tier_id, None)
+            node.tier_id = None
+            if node.key in getattr(node.parent, "children", {}):
+                self.prefix._drop_subtree(node)
+                del node.parent.children[node.key]
+            return None
+        pages = self._alloc_with_reclaim(1)
+        if pages is None:
+            self._note_exhausted(1)
+            return None
+        self._write_page(pages[0], data)
+        node.page = pages[0]
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._restore_ms.append(dt_ms)
+        self.stats["tier_restored_pages"] += 1
+        if self._m_restore is not None:
+            self._m_restore.observe(dt_ms)
+        self._note_tier("tier:restore", page=pages[0],
+                        ms=round(dt_ms, 3))
+        return pages[0]
+
+    def _resolve_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """The tier-aware admission prefix: walk the cached path, retaining
+        device pages as they come and restoring tiered entries as the pool
+        affords (spill → restore-budget — a restore that cannot get a page
+        shortens the reused prefix instead of shedding; the suffix prefill
+        covers the rest). Every returned page carries one admission hold —
+        release on rollback."""
+        if self.prefix is None:
+            return []
+        ps = self.page_size
+        nodes = self.prefix.lookup_nodes(tokens)[: (len(tokens) - 1) // ps]
+        shared: List[int] = []
+        tiered_used = False
+        for node in nodes:
+            if node.page >= 0:
+                self.allocator.retain([node.page])
+            else:
+                if self._restore_node(node) is None:
+                    break
+                tiered_used = True
+                self.allocator.retain([node.page])
+            shared.append(node.page)
+        if tiered_used:
+            self.stats["tier_hits"] += 1
+        return shared
+
+    def repair_page_from_tier(self, page: int) -> bool:
+        """Corrupted DEVICE page whose radix entry still holds an inclusive
+        host copy: verify the copy's checksum and write it back over the
+        garbled device bytes — the subtree stays valid and no stream
+        replays. False (tier absent / page not tiered / copy failed its
+        checksum) sends the caller down the invalidate+replay path."""
+        if self.tier is None or self.prefix is None:
+            return False
+        node = self.prefix.node_for_page(int(page))
+        if node is None or node.tier_id is None:
+            return False
+        t0 = time.perf_counter()
+        try:
+            data = self.tier.get(node.tier_id)
+        except (TierRestoreError, TierCorruption) as e:
+            self.stats["tier_restore_failures"] += 1
+            self._note_tier("tier:corrupt", error=type(e).__name__)
+            self.prefix._tier_nodes.pop(node.tier_id, None)
+            node.tier_id = None
+            return False
+        self._write_page(int(page), data)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._restore_ms.append(dt_ms)
+        self.stats["tier_repaired_pages"] += 1
+        if self._m_restore is not None:
+            self._m_restore.observe(dt_ms)
+        self._note_tier("tier:restore", page=int(page), repair=True,
+                        ms=round(dt_ms, 3))
+        return True
 
     # --- observability ---------------------------------------------------
 
     def attach_observability(self, tracer, metrics) -> None:
         """Wire the serving engine's tracer/registry into the cache seams:
-        prefix-hit lengths (histogram + instants), LRU evictions, and pool
-        exhaustion land on the ``cache`` timeline lane. Host-side only —
-        nothing here can touch a compiled program."""
+        prefix-hit lengths (histogram + instants), LRU evictions, pool
+        exhaustion, and the tier's spill/restore/corrupt lifecycle land on
+        the ``cache`` timeline lanes. Host-side only — nothing here can
+        touch a compiled program."""
         self._tracer = tracer
         self._m_prefix = metrics.histogram(
             "serve_prefix_hit_tokens",
             help="page-aligned prefix tokens reused per admission query",
             lo=1.0)
+        self._m_restore = metrics.histogram(
+            "serve_tier_restore_ms",
+            help="host-tier page restore wall ms (checksum + alloc + copy)",
+            lo=0.01)
+        self._m_tier_bytes = metrics.gauge(
+            "serve_tier_bytes", help="host-tier KV bytes resident")
 
     def _note_prefix(self, shared: List[int]) -> None:
         if self._m_prefix is not None:
@@ -352,13 +783,24 @@ class PagedKVCache:
                 args={"need": int(need),
                       "free": int(self.allocator.available())})
 
+    def _note_tier(self, name: str, **args) -> None:
+        if self._m_tier_bytes is not None:
+            self._m_tier_bytes.set(self.tier_bytes())
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                name, ("cache", "tier"),
+                args={**args, "tier_pages": self.tier_pages()})
+
     # --- admission lifecycle --------------------------------------------
 
     def plan(self, tokens: Sequence[int], reserve_total: int) -> InsertPlan:
         """Plan one admission: longest page-aligned cached prefix (clamped
-        below the last prompt token, so suffix prefill is never empty) plus
-        freshly allocated pages covering ``reserve_total`` logical tokens.
-        Tries LRU eviction of cache-only pages before raising
+        below the last prompt token, so suffix prefill is never empty —
+        tiered entries are RESTORED into fresh device pages as the pool
+        affords) plus freshly allocated pages covering ``reserve_total``
+        logical tokens. Under pool pressure the ladder is spill (cold cache
+        pages move to the host tier) → restore-budget (the reused prefix
+        shortens rather than shed) → evict-drop, and only then
         :class:`PagePoolExhausted`. Holds are taken here — pair every plan
         with :meth:`commit` or :meth:`rollback`."""
         ps = self.page_size
@@ -368,7 +810,7 @@ class PagedKVCache:
         shared: List[int] = []
         if self.prefix is not None:
             self.stats["prefix_queries"] += 1
-            shared = self.prefix.lookup(tokens)[: (plen - 1) // ps]
+            shared = self._resolve_prefix(tokens)
             if shared:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += len(shared) * ps
@@ -376,22 +818,14 @@ class PagedKVCache:
         start = len(shared) * ps
         total = min(max(int(reserve_total), plen), self.max_seq_len)
         n_owned = -(-total // ps) - len(shared)
-        # hold the shared pages FIRST: at refcount 1 (cache-only) the LRU
-        # eviction below could otherwise free the very pages this plan reuses
-        self.allocator.retain(shared)
-        owned = self.allocator.alloc(n_owned)
+        # the shared pages already carry this plan's holds (refcount >= 2),
+        # so the reclaim inside the alloc below can never free them
+        owned = self._alloc_with_reclaim(n_owned)
         if owned is None:
-            if self.prefix is not None:
-                freed = self.prefix.evict(
-                    n_owned - self.allocator.available())
-                self.stats["evicted_pages"] += freed
-                self._note_evict(freed)
-            owned = self.allocator.alloc(n_owned)
-            if owned is None:
-                self.allocator.release(shared)
-                self._note_exhausted(n_owned)
-                raise PagePoolExhausted(
-                    f"need {n_owned} pages, {self.allocator.available()} free")
+            self.allocator.release(shared)
+            self._note_exhausted(n_owned)
+            raise PagePoolExhausted(
+                f"need {n_owned} pages, {self.allocator.available()} free")
         table = np.empty((self.pages_per_slot,), np.int32)
         table[: len(shared)] = shared
         table[len(shared): len(shared) + n_owned] = owned
@@ -440,10 +874,13 @@ class PagedKVCache:
 
     def begin_chunked(self, tokens: Sequence[int],
                       reserve_total: int) -> ChunkedPrefill:
-        """Open a chunked admission: prefix lookup (the reused pages are
-        retained so mid-prefill LRU eviction cannot free them) but NO owned
-        pages yet — allocation happens per chunk in :meth:`extend_chunked`.
-        Cannot exhaust the pool."""
+        """Open a chunked admission: prefix walk (the reused pages are
+        retained so mid-prefill reclaim cannot free them; tiered entries
+        restore as the pool affords — a restore mid-chunked-prefill is just
+        an earlier ``start``) but NO owned pages yet — allocation happens
+        per chunk in :meth:`extend_chunked`. Cannot raise
+        :class:`PagePoolExhausted` (a failed restore only shortens the
+        reused prefix)."""
         ps = self.page_size
         plen = len(tokens)
         if plen < 1:
@@ -451,12 +888,11 @@ class PagedKVCache:
         shared: List[int] = []
         if self.prefix is not None:
             self.stats["prefix_queries"] += 1
-            shared = self.prefix.lookup(tokens)[: (plen - 1) // ps]
+            shared = self._resolve_prefix(tokens)
             if shared:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += len(shared) * ps
             self._note_prefix(shared)
-        self.allocator.retain(shared)
         return ChunkedPrefill(tokens=list(tokens),
                               reserve_total=int(reserve_total),
                               start=len(shared) * ps, shared=list(shared))
@@ -478,12 +914,7 @@ class PagedKVCache:
         need = -(-total // ps) - len(state.shared) - len(state.owned)
         if need <= 0:
             return
-        pages = self.allocator.alloc(need)
-        if pages is None and self.prefix is not None:
-            freed = self.prefix.evict(need - self.allocator.available())
-            self.stats["evicted_pages"] += freed
-            self._note_evict(freed)
-            pages = self.allocator.alloc(need)
+        pages = self._alloc_with_reclaim(need)
         if pages is None:
             self._note_exhausted(need)
             raise PagePoolExhausted(

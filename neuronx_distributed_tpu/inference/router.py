@@ -16,9 +16,12 @@ Placement (per block, over the arrived backlog in fairness order):
 
 * **prefix affinity** — every live replica is probed with
   ``PagedKVCache.prefix_peek`` (read-only: no holds, no stats, no LRU
-  touch); a request goes where the longest page-aligned prefix of its
-  prompt is already hot, so shared-system-prompt traffic concentrates its
-  radix reuse instead of smearing cold prefills across the fleet;
+  touch, no tier restore); a request goes where the longest page-aligned
+  prefix of its prompt is already hot — and a prefix resident in a
+  replica's HOST TIER counts as hot (the peek sees tiered radix entries:
+  a restore costs ~a block where a cold re-prefill costs the whole
+  suffix), so shared-system-prompt traffic concentrates its radix reuse
+  instead of smearing cold prefills across the fleet;
 * **least-loaded / deadline-aware fallback** — no hot replica: the request
   goes to the replica with the earliest feasible TTFT (free slots first,
   then shortest backlog, breaking ties by free pages), and a structured
@@ -60,7 +63,10 @@ Replica failure (the chaos seam) and graceful drain:
   (mid-prefill unwinds atomically through the abort machinery — zero
   tokens lost), live DECODING streams finish where they are, and the
   drained replica's final state is snapshotted (``snapshots[i]``) for the
-  restart.
+  restart. Host-tier content is DELIBERATELY dropped at park (engine
+  snapshots carry the tier knob, never tier bytes — same rule as device
+  pages): a restarted replica re-prefills its way warm, which the
+  per-request rng contract keeps bit-identical (test-pinned).
 
 Observability: one shared :class:`Tracer` carries every replica's engine
 lanes (each replica records under its own ``replica<i>`` process — the
@@ -809,6 +815,13 @@ class Router:
                 "pages_in_use": (eng.session.paged.allocator.in_use()
                                  if eng.paged and eng.session.paged
                                  is not None else None),
+                # host-tier residency (None without a tier): prefix-affinity
+                # peeks count tiered prefixes as hot, so a replica's tier
+                # content is placement-relevant state worth surfacing
+                "tier_pages": (eng.session.paged.tier_pages()
+                               if eng.paged and eng.session.paged is not None
+                               and eng.session.paged.tier is not None
+                               else None),
             })
         return out
 
@@ -887,6 +900,23 @@ def run_router_trace(router: Router, trace: List[dict],
         "trace_events": len(router.tracer.events()),
         "trace_events_dropped": router.tracer.dropped,
     }
+    tiered = [eng.session.paged for eng in router.engines
+              if eng.paged and eng.session.paged is not None
+              and eng.session.paged.tier is not None]
+    if tiered:
+        # fleet-aggregate host-tier surface (per-replica residency is in
+        # replica_states): spills/restores/repairs summed across replicas
+        report.update({
+            "tier_pages_resident": sum(p.tier_pages() for p in tiered),
+            "tier_spilled_pages": sum(
+                p.stats["tier_spilled_pages"] for p in tiered),
+            "tier_restored_pages": sum(
+                p.stats["tier_restored_pages"] for p in tiered),
+            "tier_restore_failures": sum(
+                p.stats["tier_restore_failures"] for p in tiered),
+            "tier_repaired_pages": sum(
+                p.stats["tier_repaired_pages"] for p in tiered),
+        })
     tenants = {item.get("tenant", "default") for item in trace}
     if tenants != {"default"}:
         report["per_tenant"] = per_tenant_report(

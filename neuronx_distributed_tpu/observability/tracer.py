@@ -24,10 +24,12 @@ Cost contract (the tentpole's hard constraint):
 
 Lanes are ``(process, track)`` pairs: ``("req", <request_id>)`` gives every
 request its own Perfetto row; ``("engine", "dispatch"|"blocks"|"faults"|
-"snapshot"|"compile")``, ``("cache", "pool")`` and ``("trainer", ...)``
-carry the engine/cache/trainer timelines. The exporter assigns stable
-pids/tids and emits the ``process_name``/``thread_name`` metadata Perfetto
-sorts by.
+"snapshot"|"compile")``, ``("cache", "pool"|"tier")`` — the ``tier`` track
+carries the host-memory KV tier's ``tier:spill``/``tier:restore``/
+``tier:corrupt`` instants plus the ``tier_pages`` counter — and
+``("trainer", ...)`` carry the engine/cache/trainer timelines. The exporter
+assigns stable pids/tids and emits the ``process_name``/``thread_name``
+metadata Perfetto sorts by.
 """
 
 from __future__ import annotations

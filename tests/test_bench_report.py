@@ -53,6 +53,11 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_prefix_hit_ttft_ratio": 0.253,
                                       "paged_hbm_bytes_vs_slab": 0.542,
                                       "serve_tokens_per_sec_paged": 498.0,
+                                      "serve_prefix_hit_ttft_ms_tiered": 41.0,
+                                      "tier_restore_ms_p99": 6.3,
+                                      "serve_shed_rate_poolpressure": 0.66,
+                                      "serve_shed_rate_poolpressure_tiered": 0.56,
+                                      "serve_tier_restored_pages": 18,
                                       "serve_itl_p50_ms": 6.2,
                                       "serve_itl_p99_ms": 9.8,
                                       "serve_itl_p99_ms_unchunked": 61.0,
@@ -130,6 +135,16 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
     assert h["serve_decode_stall_ms_longprompt_chunked"] < \
         h["serve_decode_stall_ms_longprompt"]
+    # host-tier keys (ISSUE 8): a tiered prefix hit must undercut the cold
+    # re-prefill, the pool-pressure shed rate must fall with the tier on,
+    # and the restore-latency price tag rides the headline next to them
+    assert d["serve_prefix_hit_ttft_ms_tiered"] == \
+        h["serve_prefix_hit_ttft_ms_tiered"] == 41.0
+    assert h["serve_prefix_hit_ttft_ms_tiered"] < h["serve_cold_ttft_ms"]
+    assert h["serve_shed_rate_poolpressure_tiered"] < \
+        h["serve_shed_rate_poolpressure"]
+    assert h["tier_restore_ms_p99"] == 6.3
+    assert "serve_tier_restored_pages" not in h      # sidecar-only detail
     # overload + recovery keys (ISSUE 5): shedding must beat the unbounded
     # queue on deadline-miss rate at 2x overload, goodput must hold within
     # 10% of 1x load, and the crash-recovery replay cost rides the headline

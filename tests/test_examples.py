@@ -199,6 +199,35 @@ def test_inference_runner_serve_chunked_tiny(capsys):
     assert report["itl_p99_ms"] is not None
 
 
+def test_inference_runner_serve_host_tier_tiny(capsys):
+    """ISSUE 8 CI gate: runner.py serve on a tiny pool with two rotating
+    prefix families forces the spill/restore cycle through the CLI —
+    cold cache-only pages spill into the host tier under pool pressure,
+    the returning family's prefix RESTORES (checksum-verified) instead of
+    re-prefilling, every request still completes, and the report carries
+    the tier surface. --no_host_tier pins the off switch."""
+    import runner
+
+    args = ["serve", "--tiny", "--paged", "--page_size", "4",
+            "--max_batch", "2", "--num_requests", "12",
+            "--max_new_tokens", "6", "--fused_steps", "3",
+            "--page_pool_pages", "13", "--shared_prefix_len", "8",
+            "--prefix_families", "2", "--mean_interarrival", "2.0"]
+    runner.main(args)
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 12
+    assert report["total_generated_tokens"] == 12 * 6
+    assert report["host_tier_pages"] > 0
+    assert report["tier_spilled_pages"] > 0
+    assert report["tier_restored_pages"] > 0
+    assert report["tier_restore_failures"] == 0
+    assert report["tier_restore_ms_p99"] is not None
+    runner.main(args + ["--no_host_tier"])
+    off = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert off["requests_completed"] == 12
+    assert "host_tier_pages" not in off
+
+
 def test_inference_runner_serve_robustness_tiny(capsys):
     """ISSUE 5 CI gate: runner.py serve with deadlines, a bounded queue,
     and a seeded fault plan — the report grows the overload/robustness

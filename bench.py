@@ -1675,7 +1675,11 @@ def emit_report(report: dict) -> None:
 
     path = os.environ.get("BENCH_REPORT_PATH") or str(
         Path(__file__).resolve().with_name("BENCH_REPORT.json"))
-    report = {**report, "env": runtime_env()}
+    # the sidecar records its OWN gate set so scripts/bench_regress.py
+    # compares two artifacts under the headline-key list each was built
+    # with (ast-parsing bench.py is only the fallback for old artifacts)
+    report = {**report, "env": runtime_env(),
+              "headline_keys": list(HEADLINE_KEYS)}
     try:
         with open(path, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)

@@ -414,12 +414,26 @@ def cmd_serve(args) -> None:
             tier_pages = max(1, args.host_tier_bytes // lm.kv_page_bytes())
         else:
             tier_pages = 2 * lm.config.page_pool_pages
+    # SLO objectives (observability/slo.py): declarative TTFT/ITL targets
+    # evaluated with multi-window burn rates each block; alerts land on the
+    # trace and in serve_slo_alerts_total. The completion objective rides
+    # along whenever any SLO flag is set.
+    slos = None
+    if args.slo_ttft_ms or args.slo_itl_ms:
+        from neuronx_distributed_tpu.observability import default_slos
+
+        slos = default_slos(ttft_ms=args.slo_ttft_ms,
+                            itl_ms=args.slo_itl_ms, target=args.slo_target)
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
                   prefill_chunk_tokens=args.prefill_chunk_tokens,
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
                   block_time_ms=args.block_time_ms,
                   host_tier_pages=tier_pages,
-                  trace=bool(args.trace_out))
+                  slos=slos,
+                  # the incident trace slice reads the tracer, so arming
+                  # the flight recorder turns structured tracing on too
+                  trace=bool(args.trace_out) or bool(args.incident_dir),
+                  incident_dir=args.incident_dir)
 
     def export_observability(engine) -> None:
         # written AFTER the run so the trace covers the whole timeline; the
@@ -429,6 +443,20 @@ def cmd_serve(args) -> None:
             engine.tracer.export_chrome(args.trace_out)
         if args.metrics_out:
             engine.metrics.dump(args.metrics_out)
+
+    def observability_report(engine) -> dict:
+        # SLO/incident surface appended to the serve report: per-objective
+        # compliance + alert counts, and the flight-recorder bundle paths
+        out = {}
+        if getattr(engine, "_slo", None) is not None:
+            out["slo"] = engine.slo_status()
+        rec = getattr(engine, "incident", None)
+        if rec is not None:
+            out["incidents"] = {
+                "bundles": rec.bundles,
+                "suppressed": rec.suppressed,
+            }
+        return out
     # crash recovery: a snapshot file surviving at startup means the
     # previous serve died mid-trace — restore it and finish those streams
     # (bit-identical from the interruption point) instead of starting over
@@ -478,6 +506,10 @@ def cmd_serve(args) -> None:
             router.tracer.export_chrome(args.trace_out)
         if args.metrics_out:
             router.metrics.dump(args.metrics_out)
+        report.update(observability_report(router))
+        if slos:
+            report["slo"] = {f"replica{i}": eng.slo_status()
+                             for i, eng in enumerate(router.engines)}
         report.update({
             "model": args.model + ("_tiny" if args.tiny else ""),
             "max_batch": lm.max_batch,
@@ -504,6 +536,7 @@ def cmd_serve(args) -> None:
     warm.run()
     report = run_trace(engine, trace, snapshot_path=args.snapshot_path)
     export_observability(engine)
+    report.update(observability_report(engine))
     report.update({
         "model": args.model + ("_tiny" if args.tiny else ""),
         "max_batch": lm.max_batch,
@@ -749,6 +782,25 @@ def main(argv=None) -> None:
                             "(Prometheus text exposition; a .json path "
                             "writes the JSON snapshot) to this path after "
                             "the run")
+        p.add_argument("--incident_dir", type=str, default=None,
+                       help="serve: arm the incident flight recorder — "
+                            "deadline-miss bursts, pool-exhaustion storms, "
+                            "page corruption, dispatch fail-stop and "
+                            "replica crashes dump bounded schema-validated "
+                            "evidence bundles (trace slice + metrics "
+                            "snapshot + engine state) into this directory; "
+                            "implies tracing on")
+        p.add_argument("--slo_ttft_ms", type=float, default=None,
+                       help="serve: TTFT SLO objective in wall ms — "
+                            "evaluated with multi-window burn rates each "
+                            "block; alerts land on the trace and in "
+                            "serve_slo_alerts_total, status in the report")
+        p.add_argument("--slo_itl_ms", type=float, default=None,
+                       help="serve: inter-token latency SLO objective in "
+                            "wall ms (see --slo_ttft_ms)")
+        p.add_argument("--slo_target", type=float, default=0.95,
+                       help="serve: required good fraction for the SLO "
+                            "objectives (error budget = 1 - target)")
         p.add_argument("--fault_plan", type=str, default=None,
                        help="serve: seeded chaos plan (JSON object or path "
                             "to one): pool_exhaust_prob/pool_storm_len/"

@@ -106,7 +106,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuronx_distributed_tpu.observability import MetricsRegistry, Tracer
+from neuronx_distributed_tpu.observability import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOMonitor,
+    Tracer,
+)
+from neuronx_distributed_tpu.observability import attribution as _attribution
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
 from neuronx_distributed_tpu.inference.faults import (
     DispatchFailed,
@@ -297,6 +303,12 @@ class ServeEngine:
         tracer: Optional[Tracer] = None,
         metrics: Optional[MetricsRegistry] = None,
         name: Optional[str] = None,
+        slos: Optional[Sequence] = None,
+        incident_dir: Optional[str] = None,
+        incident: Optional[FlightRecorder] = None,
+        incident_window_blocks: int = 16,
+        incident_burst_threshold: int = 3,
+        incident_burst_window: int = 8,
     ):
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
@@ -373,6 +385,31 @@ class ServeEngine:
             "serve_itl_ms", help="wall gap between token deliveries")
         self._m_queue = self.metrics.gauge(
             "serve_queue_depth", help="arrived admission backlog")
+        # ring-buffer drops surfaced as a counter: an exported trace or a
+        # metrics scrape both learn the window is partial (ISSUE 9
+        # satellite — drops were previously sidecar-only)
+        self._m_dropped = self.metrics.counter(
+            "trace_dropped_events",
+            help="tracer ring-buffer events dropped (export is partial)")
+        # SLO burn-rate monitor (observability/slo.py): declarative
+        # objectives evaluated once per block; None (the default) costs
+        # nothing — the monitor is never constructed
+        self._slo: Optional[SLOMonitor] = None
+        if slos:
+            self._slo = SLOMonitor(self.metrics, slos, tracer=self.tracer,
+                                   lane=self.lane)
+        # incident flight recorder (observability/incident.py): trigger
+        # hooks at the failure seams dump bounded evidence bundles; a
+        # Router shares ONE recorder across its replicas via ``incident=``
+        self.incident: Optional[FlightRecorder] = incident
+        if self.incident is None and incident_dir:
+            self.incident = FlightRecorder(
+                incident_dir, tracer=self.tracer, metrics=self.metrics,
+                window_blocks=incident_window_blocks, source=self.lane)
+        self._burst_threshold = int(incident_burst_threshold)
+        self._burst_window = int(incident_burst_window)
+        self._miss_blocks: deque = deque(maxlen=64)
+        self._pool_pressure_blocks: deque = deque(maxlen=64)
         self._disp_hist: Dict[str, object] = {}
         self._submit_ts: Dict[int, float] = {}
         self._last_tok_ts: Dict[int, float] = {}
@@ -432,7 +469,8 @@ class ServeEngine:
         # only the suffix, pool pressure defers admission instead of OOMing
         self.paged = bool(getattr(lm, "paged", False))
         if self.paged and self.session.paged is not None:
-            self.session.paged.attach_observability(self.tracer, self.metrics)
+            self.session.paged.attach_observability(
+                self.tracer, self.metrics, block_fn=lambda: self.blocks)
             self._m_pool = self.metrics.gauge(
                 "serve_page_pool_in_use", help="allocated KV pages")
         # legacy counter surface, now a registry-backed view (see _StatsView)
@@ -723,6 +761,22 @@ class ServeEngine:
                      - len(self._out.get(oldest.request_id, [])))
         return max(1, -(-remaining // self.block_steps))
 
+    def _note_pool_pressure(self, reqs: Sequence[Request]) -> None:
+        """One pool-pressure episode: marks the block for the incident
+        recorder's storm detector and stamps a per-request ``pool_defer``
+        instant on each deferred request's lane — the attribution layer's
+        'pool_wait' phase boundary (a deferral otherwise looks like plain
+        queueing)."""
+        if self.incident is not None:
+            self._pool_pressure_blocks.append(self.blocks)
+        if self.tracer.enabled:
+            for r in reqs:
+                self.tracer.instant(
+                    "pool_defer", ("req", r.request_id), block=self.blocks,
+                    args={"free_pages": (
+                        self.session.paged.allocator.available()
+                        if self.session.paged is not None else None)})
+
     def _shed(self, req: Request,
               pool_bound: bool = False) -> Union[int, Rejected]:
         """Shed on an over-full arrived backlog: 'tail' rejects the
@@ -745,6 +799,8 @@ class ServeEngine:
         retry = self._retry_after()
         if pool_bound:
             retry = max(retry, self._pool_retry_after(victim))
+            if self.incident is not None:
+                self._pool_pressure_blocks.append(self.blocks)
         rej = Rejected(request_id=victim.request_id,
                        retry_after_blocks=retry,
                        queue_depth=sum(1 for r in self.queue
@@ -836,6 +892,12 @@ class ServeEngine:
                         args={"kind": kind, "attempt": attempts,
                               "error": str(e)})
                 if attempts > self.dispatch_retries:
+                    if self.incident is not None:
+                        self.incident.trigger(
+                            "dispatch_failstop", self.blocks,
+                            details={"kind": kind, "attempts": attempts,
+                                     "error": str(e)},
+                            state=self.state_summary())
                     raise DispatchFailed(
                         f"{kind} dispatch failed {attempts} times "
                         f"(retry budget {self.dispatch_retries})") from e
@@ -848,6 +910,8 @@ class ServeEngine:
         ts = self._out_ts.pop(req.request_id, [])
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
+        if self.incident is not None and (expired or self._missed(req)):
+            self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
             kind = ("cancel" if cancelled else
                     "expire" if expired else "retire")
@@ -919,6 +983,8 @@ class ServeEngine:
         self._out_ts.pop(req.request_id, None)
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
+        if self.incident is not None:
+            self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
             self.tracer.instant(
                 "expire", ("req", req.request_id), block=self.blocks,
@@ -1026,11 +1092,40 @@ class ServeEngine:
                 # eventually).
                 self.stats["deferred_admissions"] += 1
                 self.queue.extendleft(reversed(group[1:]))
+                self._note_pool_pressure(group[1:])
                 try:
                     self._insert_group(group[:1], free[:1], bucket)
                 except PagePoolExhausted:
                     self.queue.appendleft(group[0])
+                    self._note_pool_pressure(group[:1])
                     return
+
+    def _tier_marker(self) -> Optional[int]:
+        """Cumulative tier-restore count before an admission (None without
+        a tier) — paired with :meth:`_note_tier_restore` to stamp restores
+        onto the admitted request's lane."""
+        pkv = self.session.paged if self.paged else None
+        if pkv is None or pkv.tier is None:
+            return None
+        return pkv.stats["tier_restored_pages"]
+
+    def _note_tier_restore(self, group: Sequence[Request],
+                           before: Optional[int]) -> None:
+        """Per-request ``tier_restore`` instant when this admission pulled
+        pages back from the host tier: the request-lane marker that lets
+        ``request_timeline``/attribution see a PR 8 restore without joining
+        against the ``("cache", "tier")`` lane. A multi-request group
+        shares one delta (restores are per-plan inside the insert; the
+        group rows ride along so a reader knows the count is shared)."""
+        if before is None or not self.tracer.enabled:
+            return
+        delta = self.session.paged.stats["tier_restored_pages"] - before
+        if delta <= 0:
+            return
+        for r in group:
+            self.tracer.instant(
+                "tier_restore", ("req", r.request_id), block=self.blocks,
+                args={"pages": int(delta), "group_rows": len(group)})
 
     def _insert_group(self, group: List[Request], slot_ids: List[int],
                       bucket: int) -> None:
@@ -1045,10 +1140,12 @@ class ServeEngine:
         # scratch — never a neighbour); the contiguous path ignores the kwarg
         reserve = np.asarray(
             [r.max_new_tokens + self.block_steps for r in group], np.int64)
+        tier_before = self._tier_marker()
         logits = self._dispatch("insert", lambda: self.lm.insert(
             self.session, np.asarray(slot_ids, np.int32), ids, lengths=lens,
             pad_token_id=self.pad_token_id,
             reserve_tokens=reserve if self.paged else None))
+        self._note_tier_restore(group, tier_before)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
         # first token per inserted request: token index 0 of each request's
@@ -1089,10 +1186,12 @@ class ServeEngine:
         chunk = None
         written = 0
         if self.paged:
+            tier_before = self._tier_marker()
             chunk = self.session.paged.begin_chunked(
                 req.prompt.tolist(),
                 req.prompt.size + req.max_new_tokens + self.block_steps)
             written = chunk.start           # prefix hit: skip reused pages
+            self._note_tier_restore([req], tier_before)
         req.start_block = self.blocks
         self._trace_queued(req, time.perf_counter())
         if self.tracer.enabled:
@@ -1131,6 +1230,7 @@ class ServeEngine:
                 except PagePoolExhausted:
                     self._abort_prefill(slot, requeue=True)
                     self.stats["deferred_admissions"] += 1
+                    self._note_pool_pressure(())
                     return
                 tables = pkv.chunk_table(slot, st.chunk)[None]
             ids = req.prompt[st.written: st.written + n][None]
@@ -1238,6 +1338,7 @@ class ServeEngine:
                 self._replay_admission(req, pregen, ts, free[0])
             except PagePoolExhausted:
                 self.stats["deferred_admissions"] += 1
+                self._note_pool_pressure(())
                 return
             self._replay_q.popleft()
 
@@ -1257,10 +1358,12 @@ class ServeEngine:
         written = 0
         pkv = self.session.paged if self.paged else None
         if pkv is not None:
+            tier_before = self._tier_marker()
             st = pkv.begin_chunked(
                 seq.tolist(),
                 total + (req.max_new_tokens - g) + self.block_steps)
             written = st.start
+            self._note_tier_restore([req], tier_before)
         logits = None
         try:
             while written < total:
@@ -1317,9 +1420,11 @@ class ServeEngine:
         if g == 0:
             self._observe_first_token(req, slot, now, replayed=True)
         elif self.tracer.enabled:
+            # same stamp as the resumed token below: a time-sorted timeline
+            # must show replay_admit BEFORE the token it resumed
             self.tracer.instant(
                 "replay_admit", ("req", req.request_id), block=self.blocks,
-                args={"slot": int(slot), "resumed_at": int(g)})
+                ts=now, args={"slot": int(slot), "resumed_at": int(g)})
         self._record(slot, tok, now)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
@@ -1390,6 +1495,9 @@ class ServeEngine:
         rng)."""
         pkv = self.session.paged
         bad = {int(p) for p in pages}
+        all_bad = sorted(bad)
+        replays_before = self.stats["corrupt_page_replays"]
+        repairs_before = self.stats["tier_page_repairs"]
         if self.tracer.enabled:
             self.tracer.instant(
                 "fault:corrupt_pages", (self.lane, "faults"),
@@ -1403,6 +1511,8 @@ class ServeEngine:
                 self.stats["tier_page_repairs"] += len(repaired)
                 bad -= repaired
             if not bad:
+                self._incident_corruption(all_bad, replays_before,
+                                          repairs_before)
                 return
         if pkv.prefix is not None:
             pkv.prefix.invalidate_pages(sorted(bad))
@@ -1428,7 +1538,26 @@ class ServeEngine:
                     "corrupt_replay", ("req", req.request_id),
                     block=self.blocks,
                     args={"delivered": len(pregen)})
+        self._incident_corruption(all_bad, replays_before, repairs_before)
         self._drain_replays()
+
+    def _incident_corruption(self, pages: List[int], replays_before: int,
+                             repairs_before: int) -> None:
+        """Flight-recorder dump for one corruption episode: the poisoned
+        pages, how many were repaired in place from the tier vs replayed,
+        and the engine state at detection time."""
+        if self.incident is None:
+            return
+        self.incident.trigger(
+            "page_corruption", self.blocks,
+            details={
+                "pages": pages,
+                "replays": self.stats["corrupt_page_replays"] - replays_before,
+                "tier_repairs": self.stats["tier_page_repairs"]
+                - repairs_before,
+            },
+            state=self.state_summary(),
+            slo=self.slo_status())
 
     # --- router hooks: resume, drain extraction --------------------------
     # The Router's failover/drain machinery moves whole requests between
@@ -1659,6 +1788,7 @@ class ServeEngine:
         occupancy, as gauges plus Perfetto counter tracks when tracing."""
         depth = sum(1 for r in self.queue if r.arrival_block <= self.blocks)
         self._m_queue.set(depth)
+        self._m_dropped.set(self.tracer.dropped)
         tr_on = self.tracer.enabled
         if tr_on:
             self.tracer.counter("queue_depth", (self.lane, "queue"), depth,
@@ -1673,6 +1803,42 @@ class ServeEngine:
                 if pkv.tier is not None:
                     self.tracer.counter("tier_pages", ("cache", "tier"),
                                         pkv.tier_pages(), block=self.blocks)
+        if self._slo is not None:
+            fired = self._slo.observe_block(self.blocks)
+            if fired and self.incident is not None:
+                self.incident.trigger(
+                    "slo_burn", self.blocks,
+                    details={"alerts": fired},
+                    state=self.state_summary(), slo=self.slo_status())
+        if self.incident is not None:
+            self._detect_bursts()
+
+    def _detect_bursts(self) -> None:
+        """Windowed burst detectors for the flight recorder: N deadline
+        misses (or N pool-pressure episodes) inside the trailing window is
+        an incident, one miss is Tuesday. The recorder's per-kind gap
+        rate-limits a sustained storm to one bundle per window."""
+        lo = self.blocks - self._burst_window
+        misses = sum(1 for b in self._miss_blocks if b > lo)
+        if misses >= self._burst_threshold:
+            if self.incident.trigger(
+                    "deadline_miss_burst", self.blocks,
+                    details={"misses_in_window": misses,
+                             "window_blocks": self._burst_window,
+                             "expired_total": self.stats["expired"],
+                             "rejected_total": self.stats["rejected"]},
+                    state=self.state_summary(), slo=self.slo_status()):
+                self._miss_blocks.clear()
+        storms = sum(1 for b in self._pool_pressure_blocks if b > lo)
+        if storms >= self._burst_threshold:
+            if self.incident.trigger(
+                    "pool_exhaustion_storm", self.blocks,
+                    details={"episodes_in_window": storms,
+                             "window_blocks": self._burst_window,
+                             "deferred_total":
+                                 self.stats["deferred_admissions"]},
+                    state=self.state_summary(), slo=self.slo_status()):
+                self._pool_pressure_blocks.clear()
 
     def _fetch(self, arr) -> np.ndarray:
         """The block's host fetch, as an observable span: device->host copy
@@ -1819,14 +1985,84 @@ class ServeEngine:
             out.append(d)
         return out
 
+    def request_attribution(self, request_id: int) -> Optional[dict]:
+        """Critical-path decomposition of one request read off the tracer:
+        its submit->terminal span partitioned into named phases (queued /
+        pool_wait / prefill / decode / replay ...) on the virtual block
+        clock, phases guaranteed to sum to the end-to-end latency. None
+        when tracing was off. See ``observability/attribution.py``."""
+        return _attribution.request_attribution(self.tracer, request_id)
+
+    def attribution_report(self) -> dict:
+        """Aggregate phase mix over every traced request (per-tenant and
+        per-replica breakdowns included when present)."""
+        return _attribution.attribution_report(self.tracer)
+
+    def explain_deadline_miss(self, request_id: int) -> dict:
+        """Name the phase that burned a missed deadline's budget — the
+        PROFILE round-10 manual timeline read, automated."""
+        return _attribution.explain_deadline_miss(self.tracer, request_id)
+
+    def slo_status(self) -> Optional[dict]:
+        """Per-objective compliance/burn/alert snapshot (None when the
+        engine was built without ``slos``)."""
+        return None if self._slo is None else self._slo.status()
+
+    def state_summary(self) -> dict:
+        """One JSON-able card of the scheduler's current state — the
+        incident bundle's engine section (and a debugging surface in its
+        own right): queue/slot occupancy, per-slot stream progress, pool
+        and tier residency, the full stats counter set."""
+        slots = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            slots.append({
+                "slot": slot, "request_id": req.request_id,
+                "tenant": req.tenant,
+                "generated": len(self._out.get(req.request_id, ())),
+                "max_new_tokens": req.max_new_tokens,
+                "prefilling": slot in self._prefilling,
+                "done": bool(self._done[slot]),
+            })
+        out = {
+            "engine": self.lane,
+            "blocks": int(self.blocks),
+            "queue_depth": len(self.queue),
+            "arrived_depth": sum(1 for r in self.queue
+                                 if r.arrival_block <= self.blocks),
+            "prefilling": len(self._prefilling),
+            "replay_pending": len(self._replay_q),
+            "slots": slots,
+            "completed": len(self.completed),
+            "rejected": len(self.rejected),
+            "stats": dict(self.stats),
+        }
+        pkv = self.session.paged if self.paged else None
+        if pkv is not None:
+            out["pool"] = {
+                "pages": pkv.num_pages,
+                "in_use": pkv.allocator.in_use(),
+                "free": pkv.allocator.available(),
+            }
+            if pkv.tier is not None:
+                out["tier"] = {
+                    "max_pages": pkv.tier.max_pages,
+                    "resident_pages": pkv.tier_pages(),
+                }
+        return out
+
     def _sync_compile_metrics(self) -> None:
         """Mirror the lm's per-program compile timings (recorded once per
         signature at compile time, engine-independent) into the registry so
-        the exposition carries the compile-vs-execute split."""
+        the exposition carries the compile-vs-execute split. Also the final
+        refresh of the ring-buffer drop counter: retire-time events land
+        AFTER the last block's sample."""
         for sig, ms in getattr(self.lm, "compile_ms", {}).items():
             self.metrics.gauge(
                 "compile_ms", help="first-call XLA compile wall ms",
                 program=sig).set(ms)
+        self._m_dropped.set(self.tracer.dropped)
 
     def run(self, max_blocks: Optional[int] = None,
             snapshot_path: Optional[str] = None,

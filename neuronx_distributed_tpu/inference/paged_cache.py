@@ -595,6 +595,7 @@ class PagedKVCache:
         # observability (attach_observability): cache-lane trace events +
         # prefix-hit-length histogram; None => zero-cost no-ops
         self._tracer = None
+        self._block_fn = None
         self._m_prefix = None
         self._m_restore = None
         self._m_tier_bytes = None
@@ -744,13 +745,17 @@ class PagedKVCache:
 
     # --- observability ---------------------------------------------------
 
-    def attach_observability(self, tracer, metrics) -> None:
+    def attach_observability(self, tracer, metrics, block_fn=None) -> None:
         """Wire the serving engine's tracer/registry into the cache seams:
         prefix-hit lengths (histogram + instants), LRU evictions, pool
         exhaustion, and the tier's spill/restore/corrupt lifecycle land on
-        the ``cache`` timeline lanes. Host-side only — nothing here can
-        touch a compiled program."""
+        the ``cache`` timeline lanes. ``block_fn`` (the engine passes
+        ``lambda: self.blocks``) stamps each instant with the virtual block
+        so incident trace slices and the attribution layer can window
+        cache events on the scheduler clock. Host-side only — nothing here
+        can touch a compiled program."""
         self._tracer = tracer
+        self._block_fn = block_fn
         self._m_prefix = metrics.histogram(
             "serve_prefix_hit_tokens",
             help="page-aligned prefix tokens reused per admission query",
@@ -762,24 +767,28 @@ class PagedKVCache:
         self._m_tier_bytes = metrics.gauge(
             "serve_tier_bytes", help="host-tier KV bytes resident")
 
+    def _block(self) -> Optional[int]:
+        return None if self._block_fn is None else int(self._block_fn())
+
     def _note_prefix(self, shared: List[int]) -> None:
         if self._m_prefix is not None:
             self._m_prefix.observe(len(shared) * self.page_size)
         if self._tracer is not None and self._tracer.enabled and shared:
             self._tracer.instant(
-                "prefix_hit", ("cache", "pool"),
+                "prefix_hit", ("cache", "pool"), block=self._block(),
                 args={"tokens": len(shared) * self.page_size,
                       "pages": len(shared)})
 
     def _note_evict(self, freed: int) -> None:
         if freed and self._tracer is not None and self._tracer.enabled:
             self._tracer.instant("evict", ("cache", "pool"),
+                                 block=self._block(),
                                  args={"pages": int(freed)})
 
     def _note_exhausted(self, need: int) -> None:
         if self._tracer is not None and self._tracer.enabled:
             self._tracer.instant(
-                "pool_exhausted", ("cache", "pool"),
+                "pool_exhausted", ("cache", "pool"), block=self._block(),
                 args={"need": int(need),
                       "free": int(self.allocator.available())})
 
@@ -788,7 +797,7 @@ class PagedKVCache:
             self._m_tier_bytes.set(self.tier_bytes())
         if self._tracer is not None and self._tracer.enabled:
             self._tracer.instant(
-                name, ("cache", "tier"),
+                name, ("cache", "tier"), block=self._block(),
                 args={**args, "tier_pages": self.tier_pages()})
 
     # --- admission lifecycle --------------------------------------------

@@ -96,7 +96,12 @@ from neuronx_distributed_tpu.inference.engine import (
     per_tenant_report,
 )
 from neuronx_distributed_tpu.inference.faults import FaultInjector, FaultPlan
-from neuronx_distributed_tpu.observability import MetricsRegistry, Tracer
+from neuronx_distributed_tpu.observability import (
+    FlightRecorder,
+    MetricsRegistry,
+    Tracer,
+)
+from neuronx_distributed_tpu.observability import attribution as _attribution
 
 
 class NoLiveReplicas(RuntimeError):
@@ -177,6 +182,7 @@ class Router:
         trace: bool = False,
         tracer: Optional[Tracer] = None,
         metrics: Optional[MetricsRegistry] = None,
+        incident_dir: Optional[str] = None,
         **engine_kw,
     ):
         if num_replicas < 1:
@@ -207,6 +213,16 @@ class Router:
         if faults is not None:
             self._injector = (faults if isinstance(faults, FaultInjector)
                               else FaultInjector(faults))
+        # ONE flight recorder across the fleet: a replica-crash bundle must
+        # see every replica's timeline, and the bundle budget is a per-
+        # process bound, not per-replica
+        self.incident: Optional[FlightRecorder] = None
+        if incident_dir:
+            self.incident = FlightRecorder(
+                incident_dir, tracer=self.tracer, metrics=self.metrics,
+                source="router")
+        if self.incident is not None:
+            engine_kw = dict(engine_kw, incident=self.incident)
         # the fleet: one lm (shared compiled programs), N sessions. All
         # replicas take the SAME rng base — with router-assigned globally-
         # unique ids that makes streams replica-independent by construction.
@@ -577,6 +593,15 @@ class Router:
                 block=self.blocks,
                 args={"replica": i, "why": why,
                       "last_heartbeat_block": self._hb[i]})
+        if self.incident is not None:
+            placed = sum(1 for rec in self._records.values()
+                         if rec.replica == i)
+            self.incident.trigger(
+                "replica_crash", self.blocks,
+                details={"replica": i, "why": why,
+                         "placed_requests": placed,
+                         "last_heartbeat_block": self._hb[i]},
+                state=self.state_summary())
 
     def _inject_crashes(self) -> None:
         for b, i in self.crash_at:
@@ -796,6 +821,36 @@ class Router:
         return self.completed
 
     # --- introspection ----------------------------------------------------
+
+    def state_summary(self) -> dict:
+        """The incident bundle's router section: fleet topology + per-
+        replica cards + the router's own queue/fairness state."""
+        return {
+            "router": True,
+            "blocks": int(self.blocks),
+            "pending": len(self.pending),
+            "placed": sum(1 for rec in self._records.values()
+                          if rec.replica is not None),
+            "tenants": {name: {"weight": t.weight,
+                               "submitted": t.submitted}
+                        for name, t in sorted(self._tenants.items())},
+            "stats": dict(self.stats),
+            "replicas": self.replica_states(),
+        }
+
+    def attribution_report(self) -> dict:
+        """Fleet-wide critical-path report off the SHARED tracer (per-
+        replica + per-tenant phase mixes included). See
+        ``observability/attribution.py``."""
+        return _attribution.attribution_report(self.tracer)
+
+    def request_attribution(self, request_id: int) -> Optional[dict]:
+        return _attribution.request_attribution(self.tracer, request_id)
+
+    def explain_deadline_miss(self, request_id: int) -> dict:
+        """Name the phase that burned a missed deadline's budget, router
+        waits (requeue backoff, placement) included."""
+        return _attribution.explain_deadline_miss(self.tracer, request_id)
 
     def replica_states(self) -> List[dict]:
         out = []

@@ -1,20 +1,44 @@
 """Host-side observability: structured tracing (per-request timelines,
-Perfetto/Chrome export) + a Prometheus-style metrics registry.
+Perfetto/Chrome export) + a Prometheus-style metrics registry, and the
+analysis layer on top — per-request critical-path attribution
+(``attribution``), declarative SLOs with multi-window burn-rate alerting
+(``slo``), and the incident flight recorder (``incident``).
 
 Wired through the serving engine (``inference/engine.py`` — request
-lifecycle lanes, dispatch/fault/snapshot spans), the paged KV cache
-(prefix hits, evictions, pool pressure), the CausalLM program cache
-(per-signature compile timing) and the trainer step loop. Disabled-by-
-default zero-cost: a disabled tracer is one boolean check per seam, and no
-instrument ever touches a compiled program's signature.
+lifecycle lanes, dispatch/fault/snapshot spans, SLO evaluation, incident
+triggers), the Router (replica-crash bundles), the paged KV cache (prefix
+hits, evictions, pool pressure, tier spill/restore), the CausalLM program
+cache (per-signature compile timing) and the trainer step loop.
+Disabled-by-default zero-cost: a disabled tracer is one boolean check per
+seam, an engine without objectives/incident_dir constructs neither
+monitor nor recorder, and no instrument ever touches a compiled
+program's signature.
 """
 
+from neuronx_distributed_tpu.observability.attribution import (
+    PHASES,
+    attribution_report,
+    explain_deadline_miss,
+    request_attribution,
+)
+from neuronx_distributed_tpu.observability.incident import (
+    INCIDENT_KINDS,
+    FlightRecorder,
+    validate_incident_bundle,
+)
 from neuronx_distributed_tpu.observability.metrics import (
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     parse_prometheus,
+)
+from neuronx_distributed_tpu.observability.slo import (
+    DEFAULT_RULES,
+    BurnRule,
+    SLObjective,
+    SLOMonitor,
+    default_slos,
 )
 from neuronx_distributed_tpu.observability.tracer import (
     Tracer,
@@ -29,4 +53,16 @@ __all__ = [
     "parse_prometheus",
     "Tracer",
     "validate_chrome_trace",
+    "PHASES",
+    "request_attribution",
+    "attribution_report",
+    "explain_deadline_miss",
+    "SLObjective",
+    "BurnRule",
+    "SLOMonitor",
+    "DEFAULT_RULES",
+    "default_slos",
+    "FlightRecorder",
+    "INCIDENT_KINDS",
+    "validate_incident_bundle",
 ]

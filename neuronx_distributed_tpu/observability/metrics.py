@@ -50,10 +50,33 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline). Without it a label value containing a quote produces a line
+    no conforming scraper — including :func:`parse_prometheus` — can read:
+    the conformance gap the round-trip test pins."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _unescape_label(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def _labels_str(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{str(v)}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -142,6 +165,19 @@ class Histogram:
         """Upper bounds per bucket (the final one is +inf)."""
         return [self.lo * self.growth ** i
                 for i in range(len(self.counts) - 1)] + [math.inf]
+
+    def count_le(self, v: float) -> int:
+        """Observations provably <= ``v``: the cumulative count over
+        buckets whose UPPER edge is <= v. An observation in v's covering
+        bucket might exceed v, so it is excluded — a conservative lower
+        bound (the SLO monitor's 'good' count can only under-count, so a
+        burn-rate alert can only over-fire, never miss)."""
+        total = 0
+        for edge, c in zip(self.bucket_edges(), self.counts):
+            if edge > v:
+                break
+            total += c
+        return total
 
     def percentile(self, q: float) -> Optional[float]:
         """Upper edge of the bucket covering the q-th percentile (None when
@@ -277,10 +313,13 @@ class MetricsRegistry:
                 f.write(self.to_prometheus())
 
 
+# label values are quoted strings with backslash escapes, so a value may
+# legally contain '}' or '"' — the sample regex must consume quoted
+# sections atomically instead of stopping at the first brace
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+    r'(?:\{(?P<labels>(?:[^"}]|"(?:[^"\\]|\\.)*")*)\})?\s+(?P<value>\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
 def parse_prometheus(text: str) -> Dict[str, dict]:
@@ -308,7 +347,9 @@ def parse_prometheus(text: str) -> Dict[str, dict]:
         if not m:
             raise ValueError(f"line {ln}: malformed sample {line!r}")
         name = m.group("name")
-        labels = tuple(sorted(_LABEL_RE.findall(m.group("labels") or "")))
+        labels = tuple(sorted(
+            (k, _unescape_label(v))
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")))
         raw = m.group("value")
         value = math.inf if raw == "+Inf" else float(raw)
         fam = None

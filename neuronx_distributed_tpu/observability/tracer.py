@@ -184,6 +184,12 @@ class Tracer:
                              "args": {"sort_index": tid}})
             return pid, threads[lane]
 
+        # ring-buffer drops are stamped INTO the event stream (not only the
+        # sidecar otherData): a trace viewer or slice that keeps just
+        # traceEvents still learns it is looking at a partial window
+        meta.append({"name": "trace_dropped_events", "ph": "M", "pid": 0,
+                     "tid": 0, "args": {"dropped": self.dropped,
+                                        "recorded": self._recorded}})
         events: List[dict] = []
         for i, ev in enumerate(self._buf):
             pid, tid = ids(ev["lane"])
@@ -235,6 +241,7 @@ def validate_chrome_trace(doc: dict, require_request_lanes: bool = True) -> dict
     last_ts = 0.0
     names = set()
     n_real = 0
+    dropped = 0
     for i, ev in enumerate(evs):
         if not isinstance(ev, dict):
             raise ValueError(f"event {i} is not an object")
@@ -248,6 +255,13 @@ def validate_chrome_trace(doc: dict, require_request_lanes: bool = True) -> dict
                 pids[ev["pid"]] = ev["args"]["name"]
                 if ev["args"]["name"] == "req":
                     req_pid = ev["pid"]
+            elif ev["name"] == "trace_dropped_events":
+                d = (ev.get("args") or {}).get("dropped")
+                if not isinstance(d, int) or d < 0:
+                    raise ValueError(
+                        f"event {i}: trace_dropped_events metadata must "
+                        f"carry a non-negative integer 'dropped': {ev}")
+                dropped = d
             continue
         if ph not in _PHASES:
             raise ValueError(f"event {i} has unknown phase {ph!r}")
@@ -267,5 +281,13 @@ def validate_chrome_trace(doc: dict, require_request_lanes: bool = True) -> dict
         if ev["ph"] != "M" and req_pid is not None and ev["pid"] == req_pid)
     if require_request_lanes and not req_lanes:
         raise ValueError("trace has no per-request lanes")
+    # surface ring-buffer drops wherever they were stamped (metadata event
+    # and/or the exporter's otherData): a reader of the SUMMARY learns the
+    # trace is a partial window without digging for the sidecar field
+    other = doc.get("otherData")
+    if isinstance(other, dict) and isinstance(
+            other.get("dropped_events"), int):
+        dropped = max(dropped, other["dropped_events"])
     return {"events": n_real, "processes": sorted(pids.values()),
-            "request_lanes": sorted(set(req_lanes)), "names": names}
+            "request_lanes": sorted(set(req_lanes)), "names": names,
+            "dropped_events": dropped}

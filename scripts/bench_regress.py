@@ -1,0 +1,268 @@
+#!/usr/bin/env python3
+"""Bench regression gate: compare two BENCH_REPORT artifacts key-by-key
+with per-key noise tolerances and direction-of-goodness; exit nonzero on a
+regression.
+
+The BENCH_r0x trajectory has been an unguarded pile of JSON since round 1:
+a PR could halve ``serve_goodput_2x_overload`` and nothing would object
+until a human read two files side by side. This script is the missing
+gate, deliberately STDLIB-ONLY (no jax import — it must run in a bare CI
+container in milliseconds):
+
+    python scripts/bench_regress.py BASELINE.json CANDIDATE.json
+
+Artifact shapes accepted, newest first:
+
+* a raw ``BENCH_REPORT.json`` sidecar (the full report dict);
+* a driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` (the committed
+  ``BENCH_r0x.json`` files): ``parsed`` is used when present; when the
+  2000-byte tail capture truncated the headline (``parsed: null`` — e.g.
+  the committed r05), numeric key/value pairs are SALVAGED from the tail
+  fragment with a regex and the comparison runs over what survived,
+  flagged ``salvaged`` in the summary so nobody mistakes partial coverage
+  for full.
+
+Only GATED keys can fail the build: the artifact's own ``headline_keys``
+list when the sidecar carries one (bench.py records it since this PR),
+else ``HEADLINE_KEYS`` ast-parsed out of the repo's bench.py (no import —
+bench.py pulls in jax), else every common numeric key. Non-headline keys
+are compared too but only reported — device-window timings off the
+headline wobble far more than their headline cousins and must not gate.
+
+Direction-of-goodness and noise tolerance come from an ordered rule table
+(first match wins): throughput/goodput/speedup/acceptance/MFU keys are
+higher-better at 10%, latency/ms keys lower-better at 15% (device timing
+noise), miss/shed rates lower-better, ratio keys per their documented
+direction. A gated key matching no rule is reported as ``info`` — an
+unknown quantity must not silently gate in either direction. Per-key
+overrides: ``--tol serve_itl_p99_ms=0.3``; global scale: ``--tol-scale 2``.
+
+Output protocol (the repo's artifact discipline): human-readable verdict
+lines on stderr, ONE compact JSON summary as the last stdout line. Exit 0
+= no gated regression, 1 = regression, 2 = usage/load error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# ordered (pattern, direction, rel_tol) — first match wins. Patterns are
+# full-match regexes over the key name.
+RULES: List[Tuple[str, str, float]] = [
+    # explicit ratios whose direction the name alone cannot tell
+    (r"serve_tracing_overhead_ratio", "higher", 0.03),
+    (r"serve_goodput_2x_vs_1x", "higher", 0.10),
+    (r".*fairness_ratio", "lower", 0.15),
+    (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
+    # rates where less is better
+    (r".*(miss_rate|shed_rate|error_rate).*", "lower", 0.20),
+    # more is better
+    (r"value|vs_baseline", "higher", 0.05),
+    (r"(mfu_.*|.*tokens_per_sec.*|.*goodput.*|.*speedup.*|.*acceptance.*"
+     r"|.*throughput.*)", "higher", 0.10),
+    # wall/device timings: lower is better, device windows are noisy
+    (r".*(_ms|_ms_p\d+|_ms_per_token.*|_ttft_ms.*|_ms_\w+)", "lower", 0.15),
+    (r".*_bytes.*", "lower", 0.05),
+]
+
+_SALVAGE_RE = re.compile(
+    r'"([A-Za-z_][A-Za-z0-9_]*)"\s*:\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)'
+    r"\s*[,}]")
+
+
+def classify(key: str) -> Tuple[Optional[str], float]:
+    for pat, direction, tol in RULES:
+        if re.fullmatch(pat, key):
+            return direction, tol
+    return None, 0.0
+
+
+def salvage_tail(tail: str) -> Dict[str, float]:
+    """Numeric top-level-looking pairs regex-salvaged from a (possibly
+    truncated) headline fragment. Nested per-depth dicts are naturally
+    excluded: their keys are numeric strings the identifier pattern
+    rejects, and their opening brace is not a number."""
+    out: Dict[str, float] = {}
+    for k, v in _SALVAGE_RE.findall(tail):
+        out[k] = float(v)
+    return out
+
+
+def load_artifact(path: str) -> Tuple[Dict[str, float], dict]:
+    """Returns (numeric key -> value, meta). Meta records the shape the
+    numbers came from so the summary can say how trustworthy coverage is."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: artifact must be a JSON object")
+    meta = {"path": path, "salvaged": False, "headline_keys": None}
+    if "tail" in doc and "rc" in doc:           # driver wrapper
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            doc = parsed
+        else:
+            meta["salvaged"] = True
+            nums = salvage_tail(doc.get("tail") or "")
+            if not nums:
+                raise ValueError(
+                    f"{path}: parsed is null and nothing numeric could be "
+                    f"salvaged from the tail")
+            return nums, meta
+    hk = doc.get("headline_keys")
+    if isinstance(hk, list):
+        meta["headline_keys"] = [str(k) for k in hk]
+    nums = {k: float(v) for k, v in doc.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    return nums, meta
+
+
+def headline_keys_from_bench(bench_path: Path) -> Optional[List[str]]:
+    """``HEADLINE_KEYS`` literal ast-parsed out of bench.py — the gate set
+    stays in lockstep with the bench without importing it (bench.py pulls
+    in jax, which a bare CI runner may not have)."""
+    try:
+        tree = ast.parse(bench_path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "HEADLINE_KEYS":
+                    try:
+                        val = ast.literal_eval(node.value)
+                    except ValueError:
+                        return None
+                    return [str(k) for k in val]
+    return None
+
+
+def compare(base: Dict[str, float], cand: Dict[str, float],
+            gated: List[str], tol_scale: float,
+            tol_overrides: Dict[str, float]) -> dict:
+    gated_set = set(gated)
+    rows: List[dict] = []
+    for key in sorted(set(base) | set(cand)):
+        in_b, in_c = key in base, key in cand
+        if not (in_b and in_c):
+            rows.append({"key": key, "verdict": "missing" if in_b else "added",
+                         "gated": key in gated_set})
+            continue
+        b, c = base[key], cand[key]
+        direction, tol = classify(key)
+        tol = tol_overrides.get(key, tol) * tol_scale
+        if abs(b) < 1e-12:
+            rel = None
+            verdict = "info"
+        else:
+            rel = (c - b) / abs(b)
+            if direction is None:
+                verdict = "info"
+            elif direction == "higher":
+                verdict = ("regressed" if rel < -tol
+                           else "improved" if rel > tol else "ok")
+            else:
+                verdict = ("regressed" if rel > tol
+                           else "improved" if rel < -tol else "ok")
+        if verdict == "regressed" and key not in gated_set:
+            verdict = "regressed_ungated"
+        rows.append({"key": key, "base": b, "cand": c,
+                     "rel": None if rel is None else round(rel, 4),
+                     "direction": direction, "tol": round(tol, 4),
+                     "verdict": verdict, "gated": key in gated_set})
+    return {"rows": rows}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Bench artifact regression gate (exit 1 on regression)")
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--tol-scale", type=float, default=1.0,
+                    help="multiply every tolerance (default 1.0)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="KEY=REL",
+                    help="per-key relative tolerance override (repeatable)")
+    ap.add_argument("--gate-all", action="store_true",
+                    help="gate every common numeric key, not only headline")
+    ap.add_argument("--strict-missing", action="store_true",
+                    help="a gated key present in baseline but absent from "
+                         "the candidate fails the gate")
+    ap.add_argument("--bench", default=None,
+                    help="bench.py to ast-parse HEADLINE_KEYS from "
+                         "(default: sibling of this script's repo root)")
+    args = ap.parse_args(argv)
+
+    overrides: Dict[str, float] = {}
+    for spec in args.tol:
+        if "=" not in spec:
+            print(f"--tol needs KEY=REL, got {spec!r}", file=sys.stderr)
+            return 2
+        k, v = spec.split("=", 1)
+        overrides[k] = float(v)
+
+    try:
+        base, bmeta = load_artifact(args.baseline)
+        cand, cmeta = load_artifact(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    gated = cmeta["headline_keys"] or bmeta["headline_keys"]
+    gate_basis = "artifact_headline_keys"
+    if gated is None:
+        bench_path = (Path(args.bench) if args.bench
+                      else Path(__file__).resolve().parent.parent / "bench.py")
+        gated = headline_keys_from_bench(bench_path)
+        gate_basis = f"ast:{bench_path.name}" if gated else "all_common"
+    if gated is None or args.gate_all:
+        gated = sorted(set(base) & set(cand))
+        gate_basis = "all_common"
+
+    result = compare(base, cand, gated, args.tol_scale, overrides)
+    regressions = [r for r in result["rows"] if r["verdict"] == "regressed"]
+    missing = [r["key"] for r in result["rows"]
+               if r["verdict"] == "missing" and r["gated"]]
+    if args.strict_missing and missing:
+        for k in missing:
+            regressions.append({"key": k, "verdict": "regressed",
+                                "reason": "missing_from_candidate"})
+
+    for r in result["rows"]:
+        if r["verdict"] in ("regressed", "regressed_ungated", "improved"):
+            print(f"[{r['verdict']:>9}] {r['key']}: {r.get('base')} -> "
+                  f"{r.get('cand')} (rel {r.get('rel')}, tol {r.get('tol')}, "
+                  f"{r.get('direction')}-is-better)", file=sys.stderr)
+
+    counts: Dict[str, int] = {}
+    for r in result["rows"]:
+        counts[r["verdict"]] = counts.get(r["verdict"], 0) + 1
+    summary = {
+        "baseline": args.baseline,
+        "candidate": args.candidate,
+        "baseline_salvaged": bmeta["salvaged"],
+        "candidate_salvaged": cmeta["salvaged"],
+        "gate_basis": gate_basis,
+        "gated_keys": len(gated),
+        "compared": sum(1 for r in result["rows"]
+                        if r["verdict"] not in ("missing", "added")),
+        "counts": counts,
+        "regressions": [
+            {k: r.get(k) for k in
+             ("key", "base", "cand", "rel", "tol", "direction", "reason")
+             if r.get(k) is not None}
+            for r in regressions],
+        "missing_gated": missing,
+        "verdict": "regress" if regressions else "pass",
+    }
+    print(json.dumps(summary))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

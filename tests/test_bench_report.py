@@ -177,6 +177,11 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert d["env"]["jax_version"] and "backend" in d["env"]
     assert "xla_flags" in d["env"] and "jaxlib_version" in d["env"]
     assert "env" not in h
+    # the sidecar records its own gate set for scripts/bench_regress.py;
+    # the size-capped headline does not carry the list
+    assert "value" in d["headline_keys"] \
+        and "serve_tracing_overhead_ratio" in d["headline_keys"]
+    assert "headline_keys" not in h
     assert h["full_report"] == "BENCH_REPORT.json"
     assert "unit" not in h and "train_step_time_s_measured" not in h
     assert len(json.dumps(h)) < 1900, "headline must survive a 2000-byte tail"
@@ -261,3 +266,102 @@ def test_report_l1_outlier_endorses_lsq(monkeypatch, capsys, tmp_path):
     d, _ = _run_main(monkeypatch, capsys, tmp_path, {0: 0.06, 1: 0.30, 2: 0.40})
     assert d["train_L0_excess_ms"] < -5
     assert "prefer the full-LSQ" in d["train_fit_note"]
+
+
+# ------------------------------------------- bench_regress gate (ISSUE 9)
+
+import subprocess
+
+REPO = Path(__file__).resolve().parent.parent
+REGRESS = REPO / "scripts" / "bench_regress.py"
+
+
+def _regress(*argv):
+    p = subprocess.run([sys.executable, str(REGRESS), *map(str, argv)],
+                       capture_output=True, text=True)
+    lines = p.stdout.strip().splitlines()
+    summary = json.loads(lines[-1]) if lines else None
+    return p.returncode, summary, p.stderr
+
+
+def test_bench_regress_committed_r04_r05_passes():
+    """The acceptance pair: the committed r04 -> r05 trajectory must clear
+    the gate (r05's tail capture truncated the headline, so the candidate
+    side runs in salvage mode — flagged, not fatal)."""
+    rc, summary, err = _regress(REPO / "BENCH_r04.json",
+                                REPO / "BENCH_r05.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass" and not summary["regressions"]
+    assert summary["candidate_salvaged"] is True
+    assert summary["baseline_salvaged"] is False
+    # gate set came from bench.py's HEADLINE_KEYS (neither artifact
+    # predates the sidecar list), and real keys were compared
+    assert summary["gate_basis"] == "ast:bench.py"
+    assert summary["compared"] >= 10 and summary["gated_keys"] > 30
+
+
+def test_bench_regress_injected_regression_exits_nonzero(tmp_path):
+    base = json.loads((REPO / "BENCH_r04.json").read_text())["parsed"]
+    cand = dict(base)
+    cand["value"] = base["value"] * 0.7          # -30% on the headline
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    (tmp_path / "cand.json").write_text(json.dumps(cand))
+    rc, summary, err = _regress(tmp_path / "base.json",
+                                tmp_path / "cand.json")
+    assert rc == 1, err
+    assert summary["verdict"] == "regress"
+    assert [r["key"] for r in summary["regressions"]] == ["value"]
+    assert summary["regressions"][0]["direction"] == "higher"
+
+
+def test_bench_regress_direction_and_tolerance(tmp_path):
+    """Direction-of-goodness per key: a FALLING latency and a RISING
+    throughput are improvements (exit 0); the reverse beyond tolerance is
+    a regression; inside tolerance is noise. The artifact's own
+    headline_keys list is the gate set when present."""
+    keys = ["serve_itl_p99_ms", "serve_tokens_per_sec_cb"]
+    base = {"headline_keys": keys,
+            "serve_itl_p99_ms": 10.0, "serve_tokens_per_sec_cb": 500.0,
+            "spec_draft_propose_ms": 17.0}       # non-headline: never gates
+    better = {"headline_keys": keys, "serve_itl_p99_ms": 7.0,
+              "serve_tokens_per_sec_cb": 560.0,
+              "spec_draft_propose_ms": 40.0}     # ungated wobble
+    noisy = {"headline_keys": keys, "serve_itl_p99_ms": 10.9,
+             "serve_tokens_per_sec_cb": 495.0}
+    worse = {"headline_keys": keys, "serve_itl_p99_ms": 14.0,
+             "serve_tokens_per_sec_cb": 500.0}
+    for name, doc in (("base", base), ("better", better),
+                      ("noisy", noisy), ("worse", worse)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "better.json")
+    assert rc == 0 and summary["counts"]["improved"] == 2
+    assert summary["gate_basis"] == "artifact_headline_keys"
+    assert summary["counts"].get("regressed_ungated", 0) == 1
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "noisy.json")
+    assert rc == 0 and not summary["regressions"]
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "worse.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "serve_itl_p99_ms"
+    # a per-key tolerance override waives the same delta
+    rc, _, _ = _regress(tmp_path / "base.json", tmp_path / "worse.json",
+                        "--tol", "serve_itl_p99_ms=0.5")
+    assert rc == 0
+    # strict-missing: dropping a gated key fails the gate
+    dropped = {"headline_keys": keys, "serve_tokens_per_sec_cb": 500.0}
+    (tmp_path / "dropped.json").write_text(json.dumps(dropped))
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "dropped.json")
+    assert rc == 0 and summary["missing_gated"] == ["serve_itl_p99_ms"]
+    rc, _, _ = _regress(tmp_path / "base.json", tmp_path / "dropped.json",
+                        "--strict-missing")
+    assert rc == 1
+    # a garbage artifact is a usage error (exit 2), not a pass
+    (tmp_path / "junk.json").write_text("[]")
+    p = subprocess.run([sys.executable, str(REGRESS),
+                        str(tmp_path / "base.json"),
+                        str(tmp_path / "junk.json")],
+                       capture_output=True, text=True)
+    assert p.returncode == 2

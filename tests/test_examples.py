@@ -314,6 +314,43 @@ def test_inference_runner_serve_trace_and_metrics_out(capsys, tmp_path):
         assert family in fams, family
 
 
+def test_inference_runner_serve_incident_and_slo(capsys, tmp_path):
+    """ISSUE 9 CI gate: a serve run with an injected fault plan and the
+    flight recorder armed dumps schema-valid incident bundles — the
+    overload trips the deadline-miss-burst detector, the SLO monitor's
+    burn alert fires, and the report carries both surfaces."""
+    import runner
+
+    from neuronx_distributed_tpu.observability import validate_incident_bundle
+
+    inc_dir = tmp_path / "incidents"
+    runner.main(["serve", "--tiny", "--max_batch", "2",
+                 "--num_requests", "8", "--max_new_tokens", "6",
+                 "--fused_steps", "3", "--mean_interarrival", "0.1",
+                 "--ttft_deadline_ms", "2", "--deadline_ms", "12",
+                 "--slo_ttft_ms", "5",
+                 "--fault_plan",
+                 '{"dispatch_fail_prob": 0.3, "dispatch_max_failures": 1, '
+                 '"seed": 5}',
+                 "--incident_dir", str(inc_dir)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["dispatch_retries"] > 0        # the fault really fired
+    assert report["expired"] >= 3                # the burst really happened
+    # SLO surface: per-objective compliance + alert counts in the report
+    assert report["slo"]["ttft"]["observations"] > 0
+    assert report["slo"]["completion"]["target"] == 0.95
+    bundles = report["incidents"]["bundles"]
+    assert bundles, "flight recorder produced no bundles"
+    kinds = set()
+    for b in bundles:
+        summary = validate_incident_bundle(b)    # the schema gate
+        assert summary["events"] > 0
+        kinds.add(summary["kind"])
+    assert "deadline_miss_burst" in kinds
+    # bundle files live where the flag pointed
+    assert all(str(inc_dir) in b for b in bundles)
+
+
 def test_bert_pretrain_trainer_trace_and_metrics_out(tmp_path):
     """ISSUE 6 CI gate, trainer half: the shared train_loop writes a step
     timeline (one span per step on the trainer lane) and a metrics

@@ -477,3 +477,42 @@ def test_host_page_tier_store_checksum_and_lru():
     tc, dropped = tier.put(d1)
     assert dropped == [tb] and len(tier) == 2
     assert tier.bytes_used() == 2 * d1["k"].nbytes
+
+
+# ------------------------------------------- request_timeline (ISSUE 9)
+
+def test_request_timeline_covers_tier_restore_lane(stack):
+    """ISSUE 9 satellite: the PR 8 tier-restore lane is visible from the
+    REQUEST's own timeline — the admission that restored spilled prefix
+    pages carries a ``tier_restore`` instant (page count included), the
+    cache-lane ``tier:*`` instants are block-stamped, and the attribution
+    layer picks the restore up as an annotation while its phase sums still
+    close exactly."""
+    cfg, params, lm_big, lm_small = stack
+    submits = _pressure_submits()
+    eng = ServeEngine(lm_small, block_steps=K, rng=jax.random.key(42),
+                      host_tier_pages=TIER, trace=True)
+    for s in submits:
+        eng.submit(**s)
+    eng.run(max_blocks=300)
+    pkv = eng.session.paged
+    assert pkv.stats["tier_restored_pages"] > 0
+    # the A-family return (last submit) is the restore hit
+    rid = len(submits) - 1
+    tl = eng.request_timeline(rid)
+    names = [e["name"] for e in tl]
+    assert names[0] == "submit" and names[-1] == "retire"
+    assert "tier_restore" in names, names
+    ev = next(e for e in tl if e["name"] == "tier_restore")
+    assert ev["args"]["pages"] > 0 and ev["block"] is not None
+    # cache-lane tier events now ride the virtual block clock too
+    tier_evs = [e for e in eng.tracer.events(lane_group="cache")
+                if e["name"].startswith("tier:")]
+    assert tier_evs and all(e["block"] is not None for e in tier_evs)
+    assert any(e["name"] == "tier:restore" for e in tier_evs)
+    # attribution sees the restore and the invariant still closes
+    att = eng.request_attribution(rid)
+    assert att["annotations"]["tier_restored_pages"] > 0
+    assert sum(att["phases_blocks"].values()) == att["e2e_blocks"]
+    _drain_all(pkv)
+    assert pkv.allocator.in_use() == 0

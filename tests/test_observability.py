@@ -41,10 +41,16 @@ from neuronx_distributed_tpu.inference.engine import (
 from neuronx_distributed_tpu.inference.faults import FaultPlan
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from neuronx_distributed_tpu.observability import (
+    BurnRule,
+    FlightRecorder,
     MetricsRegistry,
+    SLObjective,
+    SLOMonitor,
     Tracer,
+    default_slos,
     parse_prometheus,
     validate_chrome_trace,
+    validate_incident_bundle,
 )
 
 TINY = dict(
@@ -283,7 +289,20 @@ def test_tracer_ring_buffer_and_disabled_cost():
     assert len(tr.events()) == 8 and tr.dropped == 12
     doc = tr.export_chrome()
     assert doc["otherData"]["dropped_events"] == 12
-    validate_chrome_trace(doc, require_request_lanes=False)
+    # ISSUE 9 satellite: the drop count is STAMPED into the event stream
+    # (a viewer that keeps only traceEvents still learns the window is
+    # partial) and the schema validator surfaces it in its summary
+    meta_drop = [ev for ev in doc["traceEvents"]
+                 if ev["ph"] == "M" and ev["name"] == "trace_dropped_events"]
+    assert len(meta_drop) == 1 and meta_drop[0]["args"]["dropped"] == 12
+    summary = validate_chrome_trace(doc, require_request_lanes=False)
+    assert summary["dropped_events"] == 12
+    # a full buffer reports zero everywhere
+    full = Tracer(capacity=64)
+    full.instant("x", ("engine", "t"))
+    assert validate_chrome_trace(
+        full.export_chrome(),
+        require_request_lanes=False)["dropped_events"] == 0
     off = Tracer(enabled=False)
     off.instant("x", ("engine", "t"))
     with off.span("s", ("engine", "t")):
@@ -317,3 +336,223 @@ def test_validate_chrome_trace_rejects_malformed():
         validate_chrome_trace(bad_dur, require_request_lanes=False)
     with pytest.raises(ValueError, match="request lanes"):
         validate_chrome_trace(good)
+
+
+# ---------------------------------------- prometheus conformance (ISSUE 9)
+
+def test_prometheus_label_escaping_round_trips():
+    """Conformance satellite: label values containing quotes, backslashes,
+    newlines and closing braces must survive exposition -> parse intact
+    (the spec escapes them; the old writer emitted them raw, producing
+    lines no conforming scraper could read)."""
+    reg = MetricsRegistry()
+    hairy = 'sig="insert{rows=1}"\\bucket\n8'
+    reg.counter("compile_events_total", program=hairy).inc(3)
+    reg.gauge("g", kind='q"}x').set(7)
+    h = reg.histogram("h_ms", lo=1.0, n_buckets=4, label='a"b')
+    h.observe(2.0)
+    text = reg.to_prometheus()
+    fams = parse_prometheus(text)
+    assert fams["compile_events_total"]["samples"][
+        ("compile_events_total", (("program", hairy),))] == 3.0
+    assert fams["g"]["samples"][("g", (("kind", 'q"}x'),))] == 7.0
+    labeled = [k for k in fams["h_ms"]["samples"]
+               if k[0] == "h_ms_count"]
+    assert labeled and dict(labeled[0][1])["label"] == 'a"b'
+    # and a second exposition of the parsed values is identical (stable)
+    assert reg.to_prometheus() == text
+
+
+def test_histogram_count_le_is_conservative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", lo=1.0, growth=2.0, n_buckets=6)
+    for v in (0.5, 1.0, 3.0, 7.0, 9.0, 100.0):
+        h.observe(v)
+    # edges: 1, 2, 4, 8, 16, 32, +Inf
+    assert h.count_le(8.0) == 4          # 0.5, 1.0, 3.0, 7.0
+    assert h.count_le(1.0) == 2
+    # 9.0 sits in (8, 16]: not provably <= 10, so excluded (conservative)
+    assert h.count_le(10.0) == 4
+    # a finite bound cannot vouch for the +Inf overflow bucket (100.0)...
+    assert h.count_le(1e9) == h.count - 1
+    # ... but an infinite one covers everything
+    assert h.count_le(float("inf")) == h.count
+
+
+# ------------------------------------------------- SLO burn-rate monitor
+
+def test_slo_monitor_multiwindow_burn_alerts():
+    """Unit gate on the virtual clock: a latency objective whose error
+    rate jumps from 0 to 100% must alert once both windows see the burn,
+    de-latch when the short window recovers, and re-alert on a second
+    violation — with the alert counter and tracer instants in agreement."""
+    reg = MetricsRegistry()
+    tr = Tracer()
+    h = reg.histogram("lat_ms", lo=1.0, growth=2.0, n_buckets=10)
+    mon = SLOMonitor(
+        reg, [SLObjective(name="lat", target=0.9, metric="lat_ms",
+                          objective_ms=8.0)],
+        rules=[BurnRule(long_blocks=8, short_blocks=2, factor=2.0)],
+        tracer=tr, lane="engine")
+    block = 0
+    for _ in range(4):                      # healthy: all good
+        h.observe(2.0)
+        assert mon.observe_block(block) == []
+        block += 1
+    fired_at = None
+    for _ in range(6):                      # incident: all bad
+        h.observe(100.0)
+        fired = mon.observe_block(block)
+        if fired and fired_at is None:
+            fired_at = block
+            assert fired[0]["slo"] == "lat"
+            assert fired[0]["burn_short"] > 2.0
+        block += 1
+    assert fired_at is not None, "burn never alerted"
+    assert len(mon.alerts) == 1             # latched: one alert per episode
+    st = mon.status()["lat"]
+    assert st["compliance"] < 0.9
+    assert any(r and r["alerting"] for r in st["rules"].values())
+    for _ in range(6):                      # recovery: all good again
+        h.observe(2.0)
+        mon.observe_block(block)
+        block += 1
+    assert not any(r and r["alerting"]
+                   for r in mon.status()["lat"]["rules"].values())
+    for _ in range(4):                      # second incident: fresh alert
+        h.observe(100.0)
+        mon.observe_block(block)
+        block += 1
+    assert len(mon.alerts) == 2
+    assert len(tr.events("slo_alert")) == 2
+    assert reg.counter("serve_slo_alerts_total", slo="lat",
+                       rule="8b/2b x2").value == 2
+
+
+def test_slo_error_ratio_objective():
+    reg = MetricsRegistry()
+    bad = reg.counter("serve_expired")
+    total = reg.counter("serve_inserted_requests")
+    mon = SLOMonitor(
+        reg, [SLObjective(name="completion", target=0.9, kind="error_ratio",
+                          bad="serve_expired",
+                          total="serve_inserted_requests")],
+        rules=[BurnRule(4, 2, 1.5)])
+    for b in range(4):
+        total.inc(5)
+        assert mon.observe_block(b) == []
+    total.inc(5)
+    bad.inc(4)                              # 80% errors vs 10% budget
+    fired = mon.observe_block(4)
+    total.inc(5)
+    bad.inc(4)
+    fired = fired or mon.observe_block(5)
+    assert fired and fired[0]["slo"] == "completion"
+    with pytest.raises(ValueError, match="error_ratio"):
+        SLObjective(name="x", target=0.9, kind="error_ratio")
+    with pytest.raises(ValueError, match="target"):
+        SLObjective(name="x", target=1.5, metric="m", objective_ms=1.0)
+    assert [o.name for o in default_slos(ttft_ms=5.0)] == [
+        "ttft", "completion"]
+
+
+def test_engine_slo_wiring_and_report_status(lm):
+    """Integration: an engine built with objectives evaluates them per
+    block — an impossible objective alerts, a trivial one stays quiet, and
+    both report through slo_status()."""
+    trace_kw = dict(block_steps=K, trace=True, rng=jax.random.key(11))
+    eng = ServeEngine(
+        lm, slos=[SLObjective(name="tight", target=0.9,
+                              metric="serve_ttft_ms", objective_ms=1e-6),
+                  SLObjective(name="loose", target=0.9,
+                              metric="serve_ttft_ms", objective_ms=1e9)],
+        **trace_kw)
+    for i, p in enumerate(_prompts(4, seed=13)):
+        eng.submit(p, 6, arrival_block=i)
+    eng.run()
+    st = eng.slo_status()
+    assert st["tight"]["compliance"] == 0.0 and st["tight"]["alerts"] >= 1
+    assert st["loose"]["compliance"] == 1.0 and st["loose"]["alerts"] == 0
+    assert eng.tracer.events("slo_alert")
+    # no objectives -> no monitor, no status (the zero-cost default)
+    bare = ServeEngine(lm, block_steps=K)
+    assert bare._slo is None and bare.slo_status() is None
+
+
+# ------------------------------------------------- incident flight recorder
+
+def test_flight_recorder_bounds_and_schema(tmp_path):
+    tr = Tracer()
+    reg = MetricsRegistry()
+    reg.counter("c").inc(3)
+    for b in range(30):
+        tr.instant("tok", ("req", 1), block=b)
+    rec = FlightRecorder(str(tmp_path), tracer=tr, metrics=reg,
+                         window_blocks=5, max_events=4, max_bundles=2,
+                         min_gap_blocks=8)
+    p1 = rec.trigger("manual", 20, details={"x": 1},
+                     state={"blocks": 20})
+    assert p1 is not None
+    s = validate_incident_bundle(p1)
+    assert s["kind"] == "manual" and s["has_metrics"]
+    assert s["events"] <= 4 and s["truncated"]
+    # every sliced event sits inside the declared window
+    doc = json.loads(open(p1).read())
+    assert all(20 - 5 <= ev["block"] <= 20
+               for ev in doc["trace"]["events"] if ev["block"] is not None)
+    # rate limit: same kind within min_gap is suppressed
+    assert rec.trigger("manual", 24) is None and rec.suppressed == 1
+    # bundle budget: the cap holds across kinds
+    assert rec.trigger("page_corruption", 29) is not None
+    assert rec.trigger("deadline_miss_burst", 29) is None
+    assert len(rec.bundles) == 2
+    with pytest.raises(ValueError, match="unknown incident kind"):
+        rec.trigger("nope", 1)
+    # schema gate rejects malformed bundles
+    with pytest.raises(ValueError, match="schema_version"):
+        validate_incident_bundle({"kind": "manual"})
+    bad = json.loads(open(p1).read())
+    bad["trace"]["events"].append({"name": "late", "ph": "i",
+                                   "lane": ["req", 1], "block": 99})
+    with pytest.raises(ValueError, match="postdates"):
+        validate_incident_bundle(bad)
+
+
+def test_deadline_burst_dumps_incident_bundle(lm, tmp_path):
+    """Integration: an overload that expires a burst of deadlines trips
+    the engine's burst detector exactly once (rate-limited), and the
+    bundle carries the trace slice, the state card and the metrics
+    snapshot the diagnosis needs."""
+    eng = ServeEngine(lm, block_steps=K, trace=True,
+                      rng=jax.random.key(3),
+                      incident_dir=str(tmp_path),
+                      incident_burst_threshold=3, incident_burst_window=8)
+    # 3 slots, 6 arrivals: the queued half's 2-block TTFT budget dies
+    # before the first cohort (10 tokens = 3 blocks) frees a slot
+    for p in _prompts(6, s=8, seed=9):
+        eng.submit(p, 10, ttft_deadline_ms=2.0)
+    comps = eng.run(max_blocks=300)
+    assert sum(1 for c in comps if c.expired) >= 3
+    bundles = [b for b in eng.incident.bundles
+               if "deadline_miss_burst" in b]
+    assert len(bundles) == 1
+    s = validate_incident_bundle(bundles[0])
+    assert s["kind"] == "deadline_miss_burst"
+    assert "expire" in s["names"]           # the slice shows the misses
+    doc = json.loads(open(bundles[0]).read())
+    assert doc["details"]["misses_in_window"] >= 3
+    assert doc["state"]["engine"] == "engine"
+    assert doc["state"]["stats"]["expired"] >= 3
+    assert "serve_ttft_ms" in doc["metrics"]
+
+
+def test_engine_trace_drop_counter(lm):
+    """Satellite: ring-buffer drops surface as the trace_dropped_events
+    counter (and run_trace's report) instead of dying sidecar-only."""
+    tr = Tracer(capacity=32)
+    eng = ServeEngine(lm, block_steps=K, tracer=tr)
+    for i, p in enumerate(_prompts(3, seed=17)):
+        eng.submit(p, 8, arrival_block=i)
+    eng.run()
+    assert tr.dropped > 0
+    assert eng.metrics.counter("trace_dropped_events").value == tr.dropped

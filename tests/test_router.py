@@ -528,3 +528,47 @@ def test_router_chaos_full_matrix_slow(stack):
         for eng, pkv in _drain_allocators(router):
             if router._alive[router.engines.index(eng)]:
                 assert pkv.allocator.in_use() == 0, seed
+
+
+# ------------------------------------------- request_timeline (ISSUE 9)
+
+def test_request_timeline_and_attribution_cover_failover_replay(stack):
+    """ISSUE 9 satellite: the PR 7 failover lane is visible from the
+    request's own timeline — a stream that died with its replica shows
+    pre-crash tokens, then ``replay_admit`` (``resumed_at`` = tokens
+    already delivered) on the survivor, then the resumed stream and a
+    clean retire; the attribution layer charges the gap to a
+    ``failover_replay`` phase whose width closes the invariant."""
+    cfg, params, lm_c, lm_p = stack
+    router = Router(lm_c, 2, rng=jax.random.key(42), block_steps=K,
+                    trace=True, crash_at=[(2, 1)])
+    p = _prompts(4, seed=11)
+    for i in range(4):
+        router.submit(p[i], 24)
+    router.run(max_blocks=300)
+    assert router.stats["crashes"] == 1
+    assert router.stats["failed_over_requests"] > 0
+    replayed = [rid for rid, evs in router.tracer.by_request().items()
+                if any(e["name"] == "replay_admit" for e in evs)]
+    assert replayed, "no request replayed mid-stream"
+    rid = replayed[0]
+    # the timeline resolves through ANY engine sharing the tracer
+    tl = router.engines[0].request_timeline(rid)
+    names = [e["name"] for e in tl]
+    i_replay = names.index("replay_admit")
+    assert "tok" in names[:i_replay], "no pre-crash deliveries recorded"
+    assert "tok" in names[i_replay:] and names[-1] == "retire"
+    resumed_at = tl[i_replay]["args"]["resumed_at"]
+    assert resumed_at > 0
+    # pre-crash token count == the resume index (nothing lost, nothing
+    # double-counted on the lane)
+    assert names[:i_replay].count("tok") == resumed_at
+    att = router.request_attribution(rid)
+    assert att["phases_blocks"].get("failover_replay", 0) > 0
+    assert sum(att["phases_blocks"].values()) == att["e2e_blocks"]
+    # the failover price lands in the aggregate phase mix too
+    rep = router.attribution_report()
+    assert rep["phases_blocks"]["failover_replay"]["total"] > 0
+    # a cleanly-served failover is not a deadline story
+    ex = router.explain_deadline_miss(rid)
+    assert ex["missed"] is False

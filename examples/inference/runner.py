@@ -150,6 +150,19 @@ def build_model(args):
         paged_kw = dict(page_size=args.page_size,
                         page_pool_pages=args.page_pool_pages or None,
                         prefix_cache=not args.no_prefix_cache)
+    if getattr(args, "adapters", 0) > 0:
+        # multi-LoRA serving pool: N demo adapters share this one base
+        # model via per-slot batched low-rank corrections (S-LoRA); the
+        # pool holds --adapter_pool_slots device-resident adapters
+        # (identity slot included) with LRU churn beyond that
+        if args.cmd != "serve":
+            raise SystemExit("--adapters applies to the serve subcommand")
+        if getattr(args, "quantize", False):
+            raise SystemExit("--adapters with --quantize is not supported "
+                             "(adapters factorize the fp32 base kernels)")
+        paged_kw.update(
+            lora_rank=args.adapter_rank,
+            lora_slots=args.adapter_pool_slots or args.adapters + 1)
     lm = CausalLM(cfg, params, _model_cls(args),
                   buckets=buckets, max_batch=args.max_batch, **paged_kw)
     return lm, cfg
@@ -405,6 +418,29 @@ def cmd_serve(args) -> None:
 
     lm, cfg = build_model(args)
     lm.compile()
+
+    def make_adapters():
+        # N deterministic demo adapters over the base params (rank r,
+        # nonzero B so each adapter genuinely moves the logits) — the
+        # per-user-fine-tune workload; real deployments register trained
+        # init_lora trees the same way
+        from neuronx_distributed_tpu.lora import LoraConfig, init_lora
+
+        acfg = LoraConfig(r=args.adapter_rank)
+        out = {}
+        for i in range(args.adapters):
+            ad = init_lora(lm.params, acfg, jax.random.key(1000 + i))
+            out[f"a{i}"] = {
+                k: {"lora_a": v["lora_a"],
+                    "lora_b": 0.02 * jax.random.normal(
+                        jax.random.fold_in(jax.random.key(2000 + i), j),
+                        v["lora_b"].shape, jnp.float32)}
+                for j, (k, v) in enumerate(sorted(ad.items()))}
+        return out, acfg
+
+    adapter_reg = None
+    if args.adapters:
+        adapter_reg, adapter_cfg = make_adapters()
     # host-memory KV tier (paged + prefix cache only): sized in pages from
     # --host_tier_bytes via the per-page KV footprint; 0 = auto at 2x the
     # device pool (pool pressure then spills instead of shedding)
@@ -461,7 +497,12 @@ def cmd_serve(args) -> None:
     # previous serve died mid-trace — restore it and finish those streams
     # (bit-identical from the interruption point) instead of starting over
     if args.snapshot_path and os.path.exists(args.snapshot_path):
-        engine = ServeEngine.from_snapshot(lm, args.snapshot_path, **eng_kw)
+        engine = ServeEngine.from_snapshot(
+            lm, args.snapshot_path,
+            adapters=(None if adapter_reg is None else
+                      {n: (ad, adapter_cfg)
+                       for n, ad in adapter_reg.items()}),
+            **eng_kw)
         completions = engine.run()
         export_observability(engine)
         os.remove(args.snapshot_path)
@@ -487,6 +528,8 @@ def cmd_serve(args) -> None:
         deadline_ms=args.deadline_ms,
         tenants=args.tenants,
         tenant_skew=args.tenant_skew,
+        adapters=args.adapters,
+        adapter_skew=args.adapter_skew,
         seed=args.seed,
     )
     if args.replicas > 1:
@@ -501,6 +544,9 @@ def cmd_serve(args) -> None:
                         crash_at=crash_at,
                         faults=resolve_fault_plan(args.fault_plan),
                         **eng_kw)
+        if adapter_reg:
+            for n, ad in adapter_reg.items():
+                router.register_adapter(n, ad, adapter_cfg)
         report = run_router_trace(router, trace)
         if args.trace_out:
             router.tracer.export_chrome(args.trace_out)
@@ -519,6 +565,9 @@ def cmd_serve(args) -> None:
         return
     engine = ServeEngine(lm, rng=jax.random.key(args.seed),
                          faults=resolve_fault_plan(args.fault_plan), **eng_kw)
+    if adapter_reg:
+        for n, ad in adapter_reg.items():
+            engine.register_adapter(n, ad, adapter_cfg)
     # warm every program the trace will hit (all insert widths per bucket +
     # the fused block) OUTSIDE the timed window — cmd_generate's discipline.
     # Paged mode compiles its insert programs lazily per suffix width; the
@@ -767,6 +816,22 @@ def main(argv=None) -> None:
         p.add_argument("--tenant_skew", type=float, default=1.0,
                        help="serve --tenants: Zipf exponent of the tenant "
                             "distribution (0 = uniform)")
+        p.add_argument("--adapters", type=int, default=0,
+                       help="serve: N>0 registers N demo LoRA adapters and "
+                            "labels trace requests with Zipf-skewed "
+                            "adapter names — per-request fine-tunes served "
+                            "from ONE base model via the device-resident "
+                            "adapter pool (S-LoRA batching)")
+        p.add_argument("--adapter_rank", type=int, default=8,
+                       help="serve --adapters: LoRA rank r of the demo "
+                            "adapters (= the pool's padded max rank)")
+        p.add_argument("--adapter_pool_slots", type=int, default=0,
+                       help="serve --adapters: device-resident pool slots "
+                            "incl. the identity slot (0 = adapters+1, i.e. "
+                            "no churn; smaller forces LRU load/evict churn)")
+        p.add_argument("--adapter_skew", type=float, default=1.0,
+                       help="serve --adapters: Zipf exponent of adapter "
+                            "popularity (a0 the heavy hitter; 0 = uniform)")
         p.add_argument("--crash_replica_at", type=int, default=None,
                        help="serve --replicas: crash the last replica at "
                             "this router block — its streams fail over to "

@@ -3,6 +3,11 @@ SURVEY §3.5): AOT builder with shape router, KV-cached CausalLM serving,
 samplers, the continuous-batching engine (``engine.py``). Speculative
 decoding in ``speculative.py``."""
 
+from neuronx_distributed_tpu.inference.adapters import (  # noqa: F401
+    AdapterLoadError,
+    AdapterPool,
+    AdapterPoolExhausted,
+)
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult  # noqa: F401
 from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     Completion,

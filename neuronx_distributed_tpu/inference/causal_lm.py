@@ -136,6 +136,10 @@ class DecodeSession:
     # paged mode: the host half of the paged pool (block tables, free-list
     # allocator, radix prefix index) — None on contiguous-slab sessions
     paged: Optional[PagedKVCache] = None
+    # multi-LoRA mode (lora_rank set): the session's device-resident
+    # adapter pool (inference/adapters.py) — per SESSION, like the paged
+    # pool, so router replicas sharing one lm keep independent residency
+    adapters: Optional[Any] = None
 
 
 class CausalLM:
@@ -154,6 +158,9 @@ class CausalLM:
         page_size: Optional[int] = None,
         page_pool_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        lora_rank: Optional[int] = None,
+        lora_slots: int = 0,
+        lora_targets: Optional[Tuple[str, ...]] = None,
     ):
         # keep the caller's use_flash_attention: prefill buckets >= 128 run
         # the Pallas kernel with position masks (reference prefill gating,
@@ -177,6 +184,24 @@ class CausalLM:
             self.config = dataclasses.replace(
                 self.config, page_size=int(page_size),
                 page_pool_pages=int(pool))
+        # multi-LoRA serving (inference/adapters.py): the config grows the
+        # pool dims so every targeted projection declares its per-slot A/B
+        # stacks; each session then owns an AdapterPool whose tree rides
+        # every compiled program as a read-only trailing argument (adapter
+        # loads/evicts change VALUES only — zero recompiles per mix)
+        self.lora = bool(lora_rank)
+        if self.lora:
+            slots = int(lora_slots) if lora_slots else 8
+            if slots < 2:
+                raise ValueError(
+                    f"lora_slots must be >= 2 (slot 0 is the identity "
+                    f"adapter), got {slots}")
+            over = dict(lora_rank=int(lora_rank), lora_slots=slots)
+            if lora_targets:
+                over["lora_targets"] = tuple(lora_targets)
+            self.config = dataclasses.replace(self.config, **over)
+        self._adapter_avals_cache: Optional[PyTree] = None
+        self._identity_adapters_cache: Optional[PyTree] = None
         self.params = params
         self.max_batch = max_batch
         # applied INSIDE every compiled program (e.g. int8 dequantization —
@@ -228,6 +253,102 @@ class CausalLM:
         dequantization) — every compiled program must route through it."""
         return self.param_transform(params) if self.param_transform else params
 
+    # --- multi-LoRA plumbing ---------------------------------------------
+    # Adapter-enabled programs take TWO trailing args — (adapters tree,
+    # per-row adapter_idx) — threaded as ``*ad`` so every builder and call
+    # site below stays byte-identical when lora is off. The tree is the
+    # session pool's device arrays (values change on load/evict, shapes
+    # never), the idx a tiny int32 vector the program substitutes into the
+    # tree's adapter_idx leaves at its own batch width.
+
+    def _adapter_avals(self) -> Optional[PyTree]:
+        """Abstract ``"adapters"`` collection at session width — the ONE
+        canonical aval every adapter-enabled program lowers against (pinned
+        replicated under a mesh, like the cache avals)."""
+        if not self.lora:
+            return None
+        if self._adapter_avals_cache is None:
+            ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+
+            def shape_fn(params, ids):
+                _, mut = self.model.apply(
+                    {"params": self._resolve(params)}, ids,
+                    mutable=["cache", "adapters"])
+                return mut["adapters"]
+
+            avals = jax.eval_shape(shape_fn, self.params, ids0)
+            from neuronx_distributed_tpu.parallel import mesh as ps
+
+            if ps.model_parallel_is_initialized():
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+                avals = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=repl), avals)
+            self._adapter_avals_cache = avals
+        return self._adapter_avals_cache
+
+    def new_adapter_pool(self):
+        """Fresh device-resident adapter pool (slot 0 = identity) sized by
+        the config's (lora_slots, lora_rank) — one per session."""
+        from neuronx_distributed_tpu.inference.adapters import AdapterPool
+
+        if not self.lora:
+            raise ValueError("CausalLM was built without lora_rank")
+        return AdapterPool(self._adapter_avals(), self.config.lora_rank,
+                           self.config.lora_slots)
+
+    def _identity_adapters(self) -> PyTree:
+        """All-zeros pool (every row the identity adapter) — what
+        session-less paths like :meth:`generate` feed adapter-enabled
+        programs; the correction is exactly zero."""
+        if self._identity_adapters_cache is None:
+            self._identity_adapters_cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), self._adapter_avals())
+        return self._identity_adapters_cache
+
+    def _with_adapter_idx(self, tree: PyTree, idx: jax.Array) -> PyTree:
+        """Inside-jit substitution of the per-row adapter indices into every
+        (layer-stacked) adapter_idx leaf at the program's batch width — the
+        one session tree serves programs of every row count."""
+        def fix(path, leaf):
+            if jax.tree_util.keystr(path).endswith("['adapter_idx']"):
+                return jnp.broadcast_to(idx.astype(leaf.dtype)[None, :],
+                                        (leaf.shape[0], idx.shape[0]))
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(fix, tree)
+
+    def _ad_vars(self, params, cache, ad) -> dict:
+        """The apply-variables dict shared by every program body: params
+        (+transform), optional cache, and — when the ``*ad`` tail is
+        present — the adapters collection with row-width indices."""
+        d = {"params": self._resolve(params)}
+        if cache is not None:
+            d["cache"] = cache
+        if ad:
+            adapters, aidx = ad
+            d["adapters"] = self._with_adapter_idx(adapters, aidx)
+        return d
+
+    def _ad_lower(self, rows: int) -> tuple:
+        """Trailing lowering avals for adapter-enabled programs: the
+        canonical pool avals plus a (rows,) idx — () when lora is off."""
+        if not self.lora:
+            return ()
+        return (self._adapter_avals(),
+                jax.ShapeDtypeStruct((rows,), jnp.int32))
+
+    def _ad_args(self, pool, idx) -> tuple:
+        """Trailing call args: the pool's live tree (identity zeros when no
+        pool rides along) + the per-row slot indices — () when lora is
+        off."""
+        if not self.lora:
+            return ()
+        tree = pool.tree if pool is not None else self._identity_adapters()
+        return (tree, jnp.asarray(np.asarray(idx, np.int32)))
+
     def compile(self) -> "CausalLM":
         # every cache a program RETURNS is pinned replicated (_replicate_out,
         # no-op off-mesh): session caches round-trip between AOT programs
@@ -235,19 +356,17 @@ class CausalLM:
         # unconstrained output lets GSPMD hand back a sharded cache that the
         # next call then rejects (batch-over-'edp' whenever max_batch
         # divides it; trace-shape-dependent, so it bit only some schedules)
-        def prefill_fn(params, ids):
-            logits, mut = self.model.apply({"params": self._resolve(params)}, ids,
-                                           mutable=["cache"])
+        def prefill_fn(params, ids, *ad):
+            logits, mut = self.model.apply(self._ad_vars(params, None, ad),
+                                           ids, mutable=["cache"])
             return logits, self._replicate_out(mut["cache"])
 
-        def decode_fn(params, cache, ids):
-            logits, mut = self.model.apply(
-                {"params": self._resolve(params), "cache": cache}, ids,
-                mutable=["cache"]
-            )
+        def decode_fn(params, cache, ids, *ad):
+            logits, mut = self.model.apply(self._ad_vars(params, cache, ad),
+                                           ids, mutable=["cache"])
             return logits, self._replicate_out(mut["cache"])
 
-        ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
+        ad0 = self._ad_lower(self.max_batch)
         if not self.paged:
             # paged mode never runs the stand-alone prefill (its cache init
             # would alias every slot onto page 0): all prefill goes through
@@ -257,7 +376,7 @@ class CausalLM:
                 self._prefill[bucket] = self._time_compile(
                     f"prefill_b{bucket}",
                     lambda ids=ids: jax.jit(prefill_fn)
-                    .lower(self.params, ids).compile())
+                    .lower(self.params, ids, *ad0).compile())
         # decode: donate the cache (argnum 1). Abstract cache avals suffice
         # for lowering — no need to execute a real prefill at startup
         # (_cache_avals also pins them replicated under a mesh).
@@ -266,7 +385,7 @@ class CausalLM:
         self._decode = self._time_compile(
             "decode",
             lambda: jax.jit(decode_fn, donate_argnums=(1,))
-            .lower(self.params, cache0, tok).compile())
+            .lower(self.params, cache0, tok, *ad0).compile())
         return self
 
     def compile_decode_fused(self, steps: int, sampler: Optional[Sampler] = None,
@@ -309,13 +428,12 @@ class CausalLM:
         if key in self._decode_fused:
             return self._decode_fused[key]
 
-        def fused_fn(params, cache, tok, rng, done):
+        def fused_fn(params, cache, tok, rng, done, *ad):
             def body(carry, _):
                 cache, tok, rng, done = carry
                 rng, sub = jax.random.split(rng)
                 logits, mut = self.model.apply(
-                    {"params": self._resolve(params), "cache": cache}, tok,
-                    mutable=["cache"]
+                    self._ad_vars(params, cache, ad), tok, mutable=["cache"]
                 )
                 nxt = sampler(logits[:, 0, :], sub)
                 # emission masked by done-BEFORE-this-step (the stepwise
@@ -336,7 +454,8 @@ class CausalLM:
         self._decode_fused[key] = self._time_compile(
             f"decode_fused_k{steps}",
             lambda: jax.jit(fused_fn, donate_argnums=(1,))
-            .lower(self.params, cache0, tok0, jax.random.key(0), done0)
+            .lower(self.params, cache0, tok0, jax.random.key(0), done0,
+                   *self._ad_lower(self.max_batch))
             .compile())
         return self._decode_fused[key]
 
@@ -350,8 +469,11 @@ class CausalLM:
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
         def prefill_shape(params, ids):
+            # lora lms must let the adapters collection INIT here (it is
+            # not provided): mutable and discarded — shapes only
+            mutable = ["cache", "adapters"] if self.lora else ["cache"]
             _, mut = self.model.apply({"params": self._resolve(params)}, ids,
-                                      mutable=["cache"])
+                                      mutable=mutable)
             return mut["cache"]
 
         avals = jax.eval_shape(prefill_shape, self.params, ids0)
@@ -421,13 +543,12 @@ class CausalLM:
         max_len = self.config.max_seq_len
 
         def fused_fn(params, cache, tok, slot_keys, counts, lengths, active,
-                     done, eos_ids, temperature, greedy):
+                     done, eos_ids, temperature, greedy, *ad):
             def body(carry, _):
                 cache, tok, counts, lengths, done = carry
                 sub = jax.vmap(jax.random.fold_in)(slot_keys, counts)
                 logits, mut = self.model.apply(
-                    {"params": self._resolve(params), "cache": cache}, tok,
-                    mutable=["cache"]
+                    self._ad_vars(params, cache, ad), tok, mutable=["cache"]
                 )
                 nxt = slot_sampler(logits[:, 0, :], sub, temperature, greedy)
                 out = jnp.where(done | ~active, jnp.int32(pad_token_id), nxt)
@@ -451,7 +572,8 @@ class CausalLM:
                    jnp.zeros((b,), jnp.int32),
                    jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
                    jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
-                   jnp.ones((b,), jnp.float32), jnp.ones((b,), bool))
+                   jnp.ones((b,), jnp.float32), jnp.ones((b,), bool),
+                   *self._ad_lower(b))
             .compile())
         return self._session_fused[key]
 
@@ -518,6 +640,8 @@ class CausalLM:
                 prefix_cache=self.prefix_cache)
             session.cache = _set_block_tables(session.cache,
                                               session.paged.tables)
+        if self.lora:
+            session.adapters = self.new_adapter_pool()
         return session
 
     def _check_slots(self, slot_ids: np.ndarray) -> None:
@@ -543,16 +667,18 @@ class CausalLM:
             if rows == self.max_batch and bucket in self._prefill:
                 self._insert_prefill[pkey] = self._prefill[bucket]
             else:
-                def prefill_fn(params, ids):
+                def prefill_fn(params, ids, *ad):
                     logits, mut = self.model.apply(
-                        {"params": self._resolve(params)}, ids, mutable=["cache"])
+                        self._ad_vars(params, None, ad), ids,
+                        mutable=["cache"])
                     return logits, mut["cache"]
 
                 ids0 = jnp.zeros((rows, bucket), jnp.int32)
                 self._insert_prefill[pkey] = self._time_compile(
                     f"insert_prefill_r{rows}_b{bucket}",
                     lambda: jax.jit(prefill_fn)
-                    .lower(self.params, ids0).compile())
+                    .lower(self.params, ids0, *self._ad_lower(rows))
+                    .compile())
         if rows not in self._insert_scatter:
             # pin the scatter OUTPUT to replicated: under a TP mesh the
             # freshly prefilled rows arrive head-sharded, and a plain jit
@@ -597,7 +723,8 @@ class CausalLM:
             return self._paged_insert[key]
         ppseq = self.config.max_seq_len // self.config.page_size
 
-        def insert_fn(params, cache, ids, tables, slots, starts, new_len):
+        def insert_fn(params, cache, ids, tables, slots, starts, new_len,
+                      *ad):
             def as_rows(path, leaf):
                 p = jax.tree_util.keystr(path)
                 if p.endswith("['cache_index']"):
@@ -610,7 +737,7 @@ class CausalLM:
 
             row_cache = jax.tree_util.tree_map_with_path(as_rows, cache)
             logits, mut = self.model.apply(
-                {"params": self._resolve(params), "cache": row_cache}, ids,
+                self._ad_vars(params, row_cache, ad), ids,
                 mutable=["cache"])
 
             def back(path, old, new):
@@ -645,7 +772,8 @@ class CausalLM:
                    jnp.zeros((rows, ppseq), jnp.int32),
                    jnp.zeros((rows,), jnp.int32),
                    jnp.zeros((rows,), jnp.int32),
-                   jnp.zeros((rows,), jnp.int32))
+                   jnp.zeros((rows,), jnp.int32),
+                   *self._ad_lower(rows))
             .compile())
         return self._paged_insert[key]
 
@@ -671,7 +799,7 @@ class CausalLM:
         if key in self._chunk_extend:
             return self._chunk_extend[key]
 
-        def extend_fn(params, cache, ids, slots, starts, new_len):
+        def extend_fn(params, cache, ids, slots, starts, new_len, *ad):
             def gather(path, leaf):
                 if jax.tree_util.keystr(path).endswith("['cache_index']"):
                     return jnp.broadcast_to(
@@ -682,7 +810,7 @@ class CausalLM:
 
             row_cache = jax.tree_util.tree_map_with_path(gather, cache)
             logits, mut = self.model.apply(
-                {"params": self._resolve(params), "cache": row_cache}, ids,
+                self._ad_vars(params, row_cache, ad), ids,
                 mutable=["cache"])
 
             def back(path, old, new):
@@ -711,14 +839,15 @@ class CausalLM:
                    jnp.zeros((rows, bucket), jnp.int32),
                    jnp.zeros((rows,), jnp.int32),
                    jnp.zeros((rows,), jnp.int32),
-                   jnp.zeros((rows,), jnp.int32))
+                   jnp.zeros((rows,), jnp.int32),
+                   *self._ad_lower(rows))
             .compile())
         return self._chunk_extend[key]
 
     def extend(self, session: "DecodeSession", slot_ids: np.ndarray,
                chunk_ids: np.ndarray, lengths: np.ndarray,
-               starts: np.ndarray, tables: Optional[np.ndarray] = None
-               ) -> jax.Array:
+               starts: np.ndarray, tables: Optional[np.ndarray] = None,
+               adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
         """Chunked-prefill extension: write ``lengths[i]`` new prompt tokens
         per slot at positions ``starts[i]..starts[i]+lengths[i]`` (the
         tentpole primitive behind ``ServeEngine(prefill_chunk_tokens=...)``).
@@ -753,6 +882,9 @@ class CausalLM:
         bucket = self._bucket_for(s)
         ids = np.zeros((rows, bucket), np.int32)
         ids[:, :s] = chunk_ids
+        ad = self._ad_args(session.adapters,
+                           adapter_slots if adapter_slots is not None
+                           else np.zeros((rows,), np.int32))
         if self.paged:
             if session.paged is None:
                 raise ValueError("paged CausalLM needs a session from "
@@ -763,13 +895,13 @@ class CausalLM:
             logits, cache = prog(
                 self.params, session.cache, jnp.asarray(ids),
                 jnp.asarray(tables, jnp.int32), jnp.asarray(slot_ids),
-                jnp.asarray(starts), jnp.asarray(new_len))
+                jnp.asarray(starts), jnp.asarray(new_len), *ad)
         else:
             prog = self._chunk_extend_programs(rows, bucket)
             logits, cache = prog(
                 self.params, session.cache, jnp.asarray(ids),
                 jnp.asarray(slot_ids), jnp.asarray(starts),
-                jnp.asarray(new_len))
+                jnp.asarray(new_len), *ad)
         session.cache = cache
         session.lengths[slot_ids] = new_len
         last = jnp.asarray(np.maximum(lengths - 1, 0))
@@ -777,7 +909,8 @@ class CausalLM:
 
     def _insert_paged(self, session: "DecodeSession", slot_ids: np.ndarray,
                       prompt_ids: np.ndarray, lengths: np.ndarray,
-                      reserve_tokens) -> jax.Array:
+                      reserve_tokens,
+                      adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
         """Paged admission: per-row prefix lookup + page allocation (host),
         then ONE suffix-width prefill-and-scatter program. ``reserve_tokens``
         (scalar or per-row) bounds the decode room reserved in pages —
@@ -814,7 +947,10 @@ class CausalLM:
             logits, cache = prog(
                 self.params, session.cache, jnp.asarray(ids),
                 jnp.asarray(tables), jnp.asarray(slot_ids),
-                jnp.asarray(starts), jnp.asarray(lengths, np.int32))
+                jnp.asarray(starts), jnp.asarray(lengths, np.int32),
+                *self._ad_args(session.adapters,
+                               adapter_slots if adapter_slots is not None
+                               else np.zeros((rows,), np.int32)))
         except Exception:
             # the program (or its compile) failed AFTER planning took page
             # holds: release them or the pool leaks one admission's
@@ -836,7 +972,8 @@ class CausalLM:
     def insert(self, session: "DecodeSession", slot_ids: np.ndarray,
                prompt_ids: np.ndarray, lengths: Optional[np.ndarray] = None,
                pad_token_id: int = 0,
-               reserve_tokens: Optional[Any] = None) -> jax.Array:
+               reserve_tokens: Optional[Any] = None,
+               adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
         """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
         rows and lengths are preserved (they may be mid-generation).
 
@@ -867,13 +1004,18 @@ class CausalLM:
                 raise ValueError("paged CausalLM needs a session from "
                                  "start_session() (no paged state attached)")
             return self._insert_paged(session, slot_ids, prompt_ids, lengths,
-                                      reserve_tokens)
+                                      reserve_tokens,
+                                      adapter_slots=adapter_slots)
         bucket = self._bucket_for(s)
         rows = len(slot_ids)
         prefill, scatter = self._insert_programs(rows, bucket)
         ids = np.zeros((rows, bucket), np.int32)
         ids[:, :s] = prompt_ids
-        logits, fresh = prefill(self.params, jnp.asarray(ids))
+        logits, fresh = prefill(
+            self.params, jnp.asarray(ids),
+            *self._ad_args(session.adapters,
+                           adapter_slots if adapter_slots is not None
+                           else np.zeros((rows,), np.int32)))
         session.cache = scatter(session.cache, fresh,
                                 jnp.asarray(slot_ids), jnp.asarray(lengths))
         session.lengths[slot_ids] = lengths
@@ -881,7 +1023,8 @@ class CausalLM:
         last = jnp.asarray(np.maximum(lengths - 1, 0))
         return logits[jnp.arange(rows), last]
 
-    def step(self, session: "DecodeSession", tokens: np.ndarray) -> jax.Array:
+    def step(self, session: "DecodeSession", tokens: np.ndarray,
+             adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
         """One decode step for ALL slots (inactive slots advance harmlessly —
         mask their outputs caller-side). ``tokens``: (max_batch,). Raises
         — WITHOUT mutating any accounting — when an ACTIVE slot would write
@@ -894,7 +1037,11 @@ class CausalLM:
                 f"{self.config.max_seq_len}: re-insert or retire them"
             )
         logits, cache = self._decode(
-            self.params, session.cache, jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
+            self.params, session.cache,
+            jnp.asarray(tokens, jnp.int32).reshape(-1, 1),
+            *self._ad_args(session.adapters,
+                           adapter_slots if adapter_slots is not None
+                           else np.zeros((self.max_batch,), np.int32))
         )
         # account only after the decode actually executed
         session.cache = cache
@@ -977,7 +1124,12 @@ class CausalLM:
         ids = np.zeros((self.max_batch, bucket), np.int32)
         ids[:b, :s] = prompt_ids
 
-        logits, cache = self._prefill[bucket](self.params, jnp.asarray(ids))
+        # adapter-enabled lms generate as the BASE model (identity slot 0 —
+        # the correction is exactly zero); serving with real adapters goes
+        # through sessions / ServeEngine.submit(adapter=)
+        ad = self._ad_args(None, np.zeros((self.max_batch,), np.int32))
+        logits, cache = self._prefill[bucket](self.params, jnp.asarray(ids),
+                                              *ad)
         full_lengths = np.zeros((self.max_batch,), np.int32)
         full_lengths[:b] = lengths
         cache = _set_cache_index(cache, jnp.asarray(full_lengths))
@@ -1015,7 +1167,7 @@ class CausalLM:
                     k, sampler, eos_token_id, pad_token_id)
                 toks, cache, next_tok, rng, _ = fused(
                     self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32),
-                    rng, jnp.asarray(done))
+                    rng, jnp.asarray(done), *ad)
                 for row in np.asarray(toks):                      # (K, max_batch)
                     finished = record(row, t)
                     t += 1
@@ -1027,7 +1179,8 @@ class CausalLM:
                 continue
             rng, sub = jax.random.split(rng)
             step_logits, cache = self._decode(
-                self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32)
+                self.params, cache, jnp.asarray(tok_np[:, None], jnp.int32),
+                *ad
             )
             tok_np = np.asarray(sampler(step_logits[:, 0], sub))
             finished = record(tok_np, t)

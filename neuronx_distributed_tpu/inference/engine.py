@@ -113,6 +113,10 @@ from neuronx_distributed_tpu.observability import (
     Tracer,
 )
 from neuronx_distributed_tpu.observability import attribution as _attribution
+from neuronx_distributed_tpu.inference.adapters import (
+    AdapterLoadError,
+    AdapterPoolExhausted,
+)
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
 from neuronx_distributed_tpu.inference.faults import (
     DispatchFailed,
@@ -151,6 +155,10 @@ class Request:
     # multi-tenant isolation label (the Router's fairness/quota unit; a
     # bare engine just carries it through to the completion)
     tenant: str = "default"
+    # multi-LoRA serving: name of the registered adapter this request's
+    # tokens must be sampled under (None = the base model / identity slot).
+    # Admission loads+pins it in the session's AdapterPool; retire unpins.
+    adapter: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -174,6 +182,7 @@ class Completion:
     expired: bool = False
     deadline_missed: bool = False
     tenant: str = "default"
+    adapter: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -214,6 +223,7 @@ _STAT_KEYS = (
     "cancelled", "rejected", "shed_evictions", "expired",
     "dispatch_retries", "corrupt_page_replays", "restored_requests",
     "tier_page_repairs",
+    "adapter_rejects", "adapter_load_retries",
 )
 
 
@@ -473,10 +483,43 @@ class ServeEngine:
                 self.tracer, self.metrics, block_fn=lambda: self.blocks)
             self._m_pool = self.metrics.gauge(
                 "serve_page_pool_in_use", help="allocated KV pages")
+        # multi-LoRA mode (lm built with lora_rank): admission keys on
+        # (tenant, adapter) — loading/pinning the request's adapter in the
+        # session's device-resident AdapterPool; retire unpins. The per-slot
+        # adapter_idx array rides every dispatch next to eos/temperature.
+        self.lora = bool(getattr(lm, "lora", False))
+        self._adapter_idx = np.zeros((b,), np.int32)
+        self._adapter_pins: Dict[int, str] = {}
+        if self.lora:
+            self.session.adapters.attach_observability(
+                self.tracer, self.metrics, block_fn=lambda: self.blocks)
+            if self._injector is not None:
+                self.session.adapters.fault_hook = \
+                    self._injector.on_adapter_acquire
         # legacy counter surface, now a registry-backed view (see _StatsView)
         self.stats = _StatsView(self.metrics, _STAT_KEYS)
 
     # --- submission ------------------------------------------------------
+
+    def register_adapter(self, name: str, lora_params, lora_config) -> None:
+        """Register ``name``'s LoRA weights (an ``init_lora`` tree + its
+        ``LoraConfig``) with the session's device-resident pool. Host-side
+        only — the adapter becomes device-resident at the first admission
+        that pins it (``submit(adapter=name)``)."""
+        if not self.lora:
+            raise ValueError(
+                "register_adapter requires a CausalLM built with lora_rank")
+        self.session.adapters.register(name, lora_params, lora_config)
+
+    def _validate_adapter(self, adapter: Optional[str]) -> None:
+        if adapter is None:
+            return
+        if not self.lora:
+            raise ValueError(
+                "submit(adapter=) requires a CausalLM built with lora_rank")
+        if not self.session.adapters.registered(adapter):
+            raise ValueError(
+                f"unknown adapter {adapter!r} (register_adapter first)")
 
     def _validate_submit(self, prompt: np.ndarray, max_new_tokens: int,
                          sampler: Optional[Sampler]
@@ -530,6 +573,7 @@ class ServeEngine:
                ttft_deadline_ms: Optional[float] = None,
                deadline_ms: Optional[float] = None,
                tenant: str = "default",
+               adapter: Optional[str] = None,
                request_id: Optional[int] = None) -> Union[int, "Rejected"]:
         """Queue a request; returns its id — or, when the bounded queue
         sheds it at arrival, a structured :class:`Rejected` with a
@@ -551,6 +595,7 @@ class ServeEngine:
         replica under the same id is bit-identical wherever it runs."""
         prompt, sampler, greedy = self._validate_submit(
             prompt, max_new_tokens, sampler)
+        self._validate_adapter(adapter)
         rid = self._next_id if request_id is None else int(request_id)
         req = Request(
             request_id=rid, prompt=prompt,
@@ -563,6 +608,7 @@ class ServeEngine:
             deadline_block=self._deadline_block(
                 arrival_block, deadline_ms, "deadline_ms"),
             tenant=str(tenant),
+            adapter=adapter,
         )
         return self.submit_request(req)
 
@@ -583,6 +629,7 @@ class ServeEngine:
                       "ttft_deadline_block": req.ttft_deadline_block,
                       "deadline_block": req.deadline_block,
                       "tenant": req.tenant,
+                      "adapter": req.adapter,
                       "engine": self.lane})
         # bound the ARRIVED backlog at submit time (the live-client path);
         # future-arrival submissions are scheduled arrivals, not queue
@@ -615,6 +662,7 @@ class ServeEngine:
         for i, r in enumerate(self.queue):
             if r.request_id == request_id:
                 del self.queue[i]
+                self._release_adapter(r)
                 self.stats["cancelled"] += 1
                 if self.tracer.enabled:
                     self.tracer.instant("cancel", ("req", request_id),
@@ -635,6 +683,7 @@ class ServeEngine:
         for slot, st in list(self._prefilling.items()):
             if st.req.request_id == request_id:
                 self._abort_prefill(slot, requeue=False)
+                self._release_adapter(st.req)
                 self.stats["cancelled"] += 1
                 if self.tracer.enabled:
                     self.tracer.instant("cancel", ("req", request_id),
@@ -656,6 +705,74 @@ class ServeEngine:
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
+
+    # --- adapter admission (multi-LoRA) ----------------------------------
+
+    def _acquire_adapter(self, req: Request) -> bool:
+        """Load + pin the request's adapter at admission time (no-op for
+        base requests, or when a requeued admission's pin survived). False
+        means the request did NOT admit this round:
+
+        * :class:`AdapterPoolExhausted` — every slot pinned, nothing
+          evictable: the request is shed with a structured
+          ``Rejected(reason="adapter_pool_exhausted")`` (pins return as
+          streams retire — the retry-after says when);
+        * :class:`AdapterLoadError` (the seeded ``adapter`` fault seam) —
+          requeued for a later block: a deterministic retry, NEVER a
+          silent wrong-adapter token.
+        """
+        if req.adapter is None or not self.lora:
+            return True
+        if req.request_id in self._adapter_pins:
+            return True
+        pool = self.session.adapters
+        loads_before = pool.stats["loads"]
+        try:
+            slot = pool.acquire(req.adapter)
+        except AdapterPoolExhausted:
+            rej = Rejected(
+                request_id=req.request_id,
+                retry_after_blocks=self._pool_retry_after(),
+                queue_depth=sum(1 for r in self.queue
+                                if r.arrival_block <= self.blocks),
+                reason="adapter_pool_exhausted")
+            self.rejected.append(rej)
+            self.stats["rejected"] += 1
+            self.stats["adapter_rejects"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "shed", ("req", req.request_id), block=self.blocks,
+                    args={"reason": rej.reason, "adapter": req.adapter,
+                          "retry_after_blocks": rej.retry_after_blocks})
+            return False
+        except AdapterLoadError as e:
+            self.stats["adapter_load_retries"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "adapter_defer", ("req", req.request_id),
+                    block=self.blocks,
+                    args={"adapter": req.adapter, "error": str(e)})
+            self.queue.appendleft(req)
+            return False
+        self._adapter_pins[req.request_id] = req.adapter
+        if self.tracer.enabled:
+            # the adapter-load mark inside admission: request_timeline and
+            # the attribution annotations read it off the request lane
+            self.tracer.instant(
+                "adapter_load", ("req", req.request_id), block=self.blocks,
+                args={"adapter": req.adapter, "slot": int(slot),
+                      "cold": pool.stats["loads"] > loads_before})
+        return True
+
+    def _adapter_slot(self, req: Request) -> int:
+        if req.adapter is None or not self.lora:
+            return 0
+        return self.session.adapters.slot_of(req.adapter)
+
+    def _release_adapter(self, req: Request) -> None:
+        name = self._adapter_pins.pop(req.request_id, None)
+        if name is not None:
+            self.session.adapters.release(name)
 
     # --- deadlines / shedding / dispatch (the fault-tolerance half) ------
 
@@ -801,6 +918,7 @@ class ServeEngine:
             retry = max(retry, self._pool_retry_after(victim))
             if self.incident is not None:
                 self._pool_pressure_blocks.append(self.blocks)
+        self._release_adapter(victim)
         rej = Rejected(request_id=victim.request_id,
                        retry_after_blocks=retry,
                        queue_depth=sum(1 for r in self.queue
@@ -839,6 +957,7 @@ class ServeEngine:
                 victim = max(arrived,
                              key=lambda r: (r.arrival_block, r.request_id))
             self.queue.remove(victim)
+            self._release_adapter(victim)
             self.rejected.append(Rejected(
                 request_id=victim.request_id,
                 retry_after_blocks=self._retry_after(),
@@ -910,6 +1029,7 @@ class ServeEngine:
         ts = self._out_ts.pop(req.request_id, [])
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
+        self._release_adapter(req)   # retire unpins (adapter stays resident)
         if self.incident is not None and (expired or self._missed(req)):
             self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
@@ -934,6 +1054,7 @@ class ServeEngine:
             cancelled=cancelled, expired=expired,
             deadline_missed=expired or self._missed(req),
             tenant=req.tenant,
+            adapter=req.adapter,
         )
 
     def _complete_slot(self, slot: int, cancelled: bool = False,
@@ -944,6 +1065,7 @@ class ServeEngine:
         self.slots[slot] = None
         self._active[slot] = False
         self._done[slot] = False
+        self._adapter_idx[slot] = 0
 
     def _trace_queued(self, req: Request, now: float) -> None:
         """Close the request's 'queued' lifecycle span (submit wall stamp ->
@@ -983,6 +1105,7 @@ class ServeEngine:
         self._out_ts.pop(req.request_id, None)
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
+        self._release_adapter(req)
         if self.incident is not None:
             self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
@@ -999,6 +1122,7 @@ class ServeEngine:
             token_ts=np.zeros((0,), np.float64),
             expired=True, deadline_missed=True,
             tenant=req.tenant,
+            adapter=req.adapter,
         ))
         self.stats["expired"] += 1
 
@@ -1058,16 +1182,24 @@ class ServeEngine:
             self._shed_overflow()
 
     def _admit_loop(self) -> None:
+        # requests whose adapter load faulted THIS pass sit out the rest of
+        # it (they were requeued for a later block); without the set a
+        # head-of-queue load fault would spin the admission loop forever
+        deferred: set = set()
         while True:
             free = self._free_slots()
             if not free:
                 return
-            order = self._arrived_sorted()
+            order = [r for r in self._arrived_sorted()
+                     if r.request_id not in deferred]
             if not order:
                 return
             head = order[0]
             if self._is_chunked(head):
                 self.queue.remove(head)
+                if not self._acquire_adapter(head):
+                    deferred.add(head.request_id)
+                    continue
                 self._begin_chunked(head, free[0])
                 continue
             bucket = self.lm._bucket_for(head.prompt.size)
@@ -1079,6 +1211,19 @@ class ServeEngine:
                 group.append(r)
             for r in group:
                 self.queue.remove(r)
+            # (tenant, adapter)-keyed admission: each request's adapter is
+            # loaded+pinned before any device work; a failed acquire drops
+            # the request out of the group (shed or requeued) while its
+            # groupmates still ride one right-sized insert
+            admitted = []
+            for r in group:
+                if self._acquire_adapter(r):
+                    admitted.append(r)
+                else:
+                    deferred.add(r.request_id)
+            group = admitted
+            if not group:
+                continue
             try:
                 self._insert_group(group, free[: len(group)], bucket)
             except PagePoolExhausted:
@@ -1140,11 +1285,14 @@ class ServeEngine:
         # scratch — never a neighbour); the contiguous path ignores the kwarg
         reserve = np.asarray(
             [r.max_new_tokens + self.block_steps for r in group], np.int64)
+        aslots = (np.asarray([self._adapter_slot(r) for r in group], np.int32)
+                  if self.lora else None)
         tier_before = self._tier_marker()
         logits = self._dispatch("insert", lambda: self.lm.insert(
             self.session, np.asarray(slot_ids, np.int32), ids, lengths=lens,
             pad_token_id=self.pad_token_id,
-            reserve_tokens=reserve if self.paged else None))
+            reserve_tokens=reserve if self.paged else None,
+            adapter_slots=aslots))
         self._note_tier_restore(group, tier_before)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
@@ -1175,6 +1323,7 @@ class ServeEngine:
             self._tok[slot] = int(first[i])
             self._slot_keys = self._slot_keys.at[slot].set(keys[i])
             self._gen_counts[slot] = 1
+            self._adapter_idx[slot] = 0 if aslots is None else aslots[i]
             self._record(slot, int(first[i]), now)
 
     # --- chunked prefill (the stall-free admission path) ------------------
@@ -1204,6 +1353,9 @@ class ServeEngine:
         self._done[slot] = False
         self._slot_keys = self._slot_keys.at[slot].set(
             self._req_key(req.request_id))
+        # chunk prefill must already run under the request's adapter — the
+        # KV it writes is adapter-specific
+        self._adapter_idx[slot] = self._adapter_slot(req)
         self._prefilling[slot] = _PrefillInFlight(
             req=req, slot=slot, written=written, chunk=chunk)
         self._prefill_q.append(slot)
@@ -1234,10 +1386,12 @@ class ServeEngine:
                     return
                 tables = pkv.chunk_table(slot, st.chunk)[None]
             ids = req.prompt[st.written: st.written + n][None]
+            aslots = (np.asarray([self._adapter_idx[slot]], np.int32)
+                      if self.lora else None)
             logits = self._dispatch("extend", lambda: self.lm.extend(
                 self.session, np.asarray([slot], np.int32), ids,
                 np.asarray([n], np.int32), np.asarray([st.written], np.int32),
-                tables=tables))
+                tables=tables, adapter_slots=aslots))
             self.stats["chunk_program_calls"] += 1
             self.stats["prefill_chunk_tokens_done"] += n
             st.written += n
@@ -1304,6 +1458,7 @@ class ServeEngine:
                                                    pkv.tables)
         self.slots[slot] = None
         self._active[slot] = False
+        self._adapter_idx[slot] = 0
         self.session.lengths[slot] = 0
         self.session.active[slot] = False
         self.stats["prefill_aborts"] += 1
@@ -1340,6 +1495,20 @@ class ServeEngine:
                 self.stats["deferred_admissions"] += 1
                 self._note_pool_pressure(())
                 return
+            except AdapterPoolExhausted:
+                # a replay is a stream the client is already consuming: it
+                # is never shed — it waits for a pin to return, exactly
+                # like pool pressure defers to the next block
+                self.stats["deferred_admissions"] += 1
+                return
+            except AdapterLoadError:
+                self.stats["adapter_load_retries"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "adapter_defer", ("req", req.request_id),
+                        block=self.blocks,
+                        args={"adapter": req.adapter, "state": "replay"})
+                return
             self._replay_q.popleft()
 
     def _replay_admission(self, req: Request, pregen: List[int],
@@ -1349,6 +1518,16 @@ class ServeEngine:
         largest-bucket ``extend`` chunks (prefix-cache hits skip shared
         pages where they survive), then sample token ``g`` under
         ``fold_in(req_key, g)`` — bit-identical to the uninterrupted run."""
+        aslot = 0
+        if self.lora and req.adapter is not None:
+            # re-pin the stream's adapter BEFORE any page work (it may have
+            # been evicted while the request sat in the replay queue);
+            # exhaustion/load faults propagate to _drain_replays, which
+            # defers the replay to a later block — never a wrong adapter
+            if req.request_id not in self._adapter_pins:
+                self.session.adapters.acquire(req.adapter)
+                self._adapter_pins[req.request_id] = req.adapter
+            aslot = self.session.adapters.slot_of(req.adapter)
         g = len(pregen)
         seq = (np.concatenate([req.prompt, np.asarray(pregen, np.int32)])
                if g else np.asarray(req.prompt, np.int32))
@@ -1378,7 +1557,9 @@ class ServeEngine:
                 logits = self._dispatch("extend", lambda: self.lm.extend(
                     self.session, np.asarray([slot], np.int32), ids,
                     np.asarray([n], np.int32), np.asarray([w], np.int32),
-                    tables=tables))
+                    tables=tables,
+                    adapter_slots=(np.asarray([aslot], np.int32)
+                                   if self.lora else None)))
                 written += n
         except BaseException:
             # atomic unwind: every page hold released, device table reset —
@@ -1417,6 +1598,7 @@ class ServeEngine:
         self._tok[slot] = tok
         self._slot_keys = self._slot_keys.at[slot].set(key)
         self._gen_counts[slot] = g + 1
+        self._adapter_idx[slot] = aslot
         if g == 0:
             self._observe_first_token(req, slot, now, replayed=True)
         elif self.tracer.enabled:
@@ -1531,6 +1713,7 @@ class ServeEngine:
             self.slots[slot] = None
             self._active[slot] = False
             self._done[slot] = False
+            self._adapter_idx[slot] = 0   # the pin survives for the replay
             self._replay_q.append((req, pregen, ts))
             self.stats["corrupt_page_replays"] += 1
             if self.tracer.enabled:
@@ -1585,23 +1768,32 @@ class ServeEngine:
         out = list(self.queue)
         self.queue.clear()
         self._m_queue.set(0)
+        for r in out:
+            self._release_adapter(r)   # the pin migrates with the request
         return out
 
     def extract_prefilling(self) -> List[Request]:
         """Abort every in-flight chunked admission (atomic page rollback —
         the cancel machinery) and return the requests for re-placement.
-        Spent chunk work is discarded; correctness never depends on it."""
+        Spent chunk work is discarded; correctness never depends on it.
+        Adapter pins move WITH the work: released here, re-taken by the
+        destination replica's admission."""
         out = []
         for slot in list(self._prefilling):
-            out.append(self._prefilling[slot].req)
+            req = self._prefilling[slot].req
+            out.append(req)
             self._abort_prefill(slot, requeue=False)
+            self._release_adapter(req)
         return out
 
     def extract_replays(self) -> List[Tuple[Request, List[int]]]:
         """Remove and return pending recovery replays as (request,
-        generated-so-far) pairs — drained replicas hand them to peers."""
+        generated-so-far) pairs — drained replicas hand them to peers
+        (adapter pins released here, re-taken at the destination)."""
         out = [(req, list(gen)) for req, gen, _ts in self._replay_q]
         self._replay_q.clear()
+        for req, _gen in out:
+            self._release_adapter(req)
         return out
 
     def has_decode_work(self) -> bool:
@@ -1634,6 +1826,7 @@ class ServeEngine:
                 "generated": [int(t) for t in generated],
                 "state": state,
                 "tenant": r.tenant,
+                "adapter": r.adapter,
             }
 
         reqs = []
@@ -1691,6 +1884,7 @@ class ServeEngine:
 
     @classmethod
     def from_snapshot(cls, lm: CausalLM, snap: Union[dict, str],
+                      adapters: Optional[dict] = None,
                       **overrides) -> "ServeEngine":
         """Rebuild an engine from a :meth:`snapshot` (dict or file path) on
         a fresh session: queued requests re-enter the queue with their
@@ -1714,6 +1908,12 @@ class ServeEngine:
         rng = jax.random.wrap_key_data(
             jnp.asarray(snap["rng"], jnp.uint32))
         eng = cls(lm, rng=rng, **cfg)
+        # adapter WEIGHTS are not snapshotted (like device pages, the pool
+        # dies with the process): ``adapters`` re-registers {name:
+        # (lora_params, lora_config)} so the replays below can re-pin
+        if adapters:
+            for name, (lp, lc) in adapters.items():
+                eng.register_adapter(name, lp, lc)
         eng.blocks = int(snap["blocks"])
         eng._next_id = int(snap["next_id"])
         for rd in snap["requests"]:
@@ -1729,6 +1929,7 @@ class ServeEngine:
                 ttft_deadline_block=rd.get("ttft_deadline_block"),
                 deadline_block=rd.get("deadline_block"),
                 tenant=rd.get("tenant", "default"),
+                adapter=rd.get("adapter"),
             )
             if rd["state"] == "decoding":
                 eng._replay_q.append(
@@ -1803,6 +2004,15 @@ class ServeEngine:
                 if pkv.tier is not None:
                     self.tracer.counter("tier_pages", ("cache", "tier"),
                                         pkv.tier_pages(), block=self.blocks)
+        if self.lora:
+            # resident-adapter counter track (Perfetto) + gauge refresh —
+            # the "adapter_pool_pages" name mirrors pages_in_use: a slot is
+            # the pool's allocation unit exactly like a KV page
+            pool = self.session.adapters
+            if tr_on:
+                self.tracer.counter("adapter_pool_pages",
+                                    ("cache", "adapter"), pool.in_use(),
+                                    block=self.blocks)
         if self._slo is not None:
             fired = self._slo.observe_block(self.blocks)
             if fired and self.incident is not None:
@@ -1919,7 +2129,9 @@ class ServeEngine:
                     jnp.asarray(self._gen_counts),
                     jnp.asarray(self._lengths), jnp.asarray(self._active),
                     jnp.asarray(self._done), jnp.asarray(self._eos),
-                    jnp.asarray(self._temp), jnp.asarray(self._greedy))
+                    jnp.asarray(self._temp), jnp.asarray(self._greedy),
+                    *self.lm._ad_args(self.session.adapters,
+                                      self._adapter_idx))
             toks, cache, _nxt, _len, _done = self._dispatch(
                 "decode", lambda: fused(*args))
             self.session.cache = cache
@@ -1946,7 +2158,9 @@ class ServeEngine:
             logits, cache = self._dispatch(
                 "decode", lambda t=tok: self.lm._decode(
                     self.lm.params, self.session.cache,
-                    jnp.asarray(t[:, None], jnp.int32)))
+                    jnp.asarray(t[:, None], jnp.int32),
+                    *self.lm._ad_args(self.session.adapters,
+                                      self._adapter_idx)))
             self.session.cache = cache
             self.session.lengths += 1
             nxt = self._fetch(self.slot_sampler(logits[:, 0], sub, temp,
@@ -2050,6 +2264,14 @@ class ServeEngine:
                     "max_pages": pkv.tier.max_pages,
                     "resident_pages": pkv.tier_pages(),
                 }
+        if self.lora:
+            pool = self.session.adapters
+            out["adapters"] = {
+                "slots": pool.n_slots,
+                "resident": sorted(pool.resident),
+                "pinned": {n: pool.pinned(n) for n in sorted(pool.resident)
+                           if pool.pinned(n)},
+            }
         return out
 
     def _sync_compile_metrics(self) -> None:
@@ -2102,6 +2324,8 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                     deadline_ms: Optional[float] = None,
                     tenants: int = 0,
                     tenant_skew: float = 1.0,
+                    adapters: int = 0,
+                    adapter_skew: float = 1.0,
                     seed: int = 0) -> List[dict]:
     """Deterministic synthetic arrival trace (virtual time in blocks):
     exponential inter-arrivals, prompt lengths cycled through
@@ -2127,7 +2351,15 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     1/(k+1)^tenant_skew — t0 is the heavy hitter; skew 0 is uniform): the
     multi-tenant burst workload the Router's weighted fair queueing and
     tenant-aware shedding exist for. ``run_trace``/``run_router_trace``
-    then report the per-tenant latency/goodput surface."""
+    then report the per-tenant latency/goodput surface.
+
+    ``adapters > 0`` labels each request with an adapter name drawn from
+    its own Zipf distribution over ``a0..a<adapters-1>`` (independent
+    stream — adding adapter labels never shifts the tenant draws): the
+    every-user-their-own-fine-tune workload of the multi-LoRA pool. Low
+    ``adapter_skew`` spreads traffic across adapters (pool churn when the
+    pool holds fewer), high skew concentrates it (a0 stays hot). The
+    caller must ``register_adapter`` every name the trace uses."""
     if long_prompt_frac < 0 or long_prompt_frac > 1:
         raise ValueError(f"long_prompt_frac must be in [0, 1], got {long_prompt_frac}")
     if long_prompt_frac > 0 and long_prompt_len < 1:
@@ -2136,6 +2368,10 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         raise ValueError(f"tenants must be >= 0, got {tenants}")
     if tenant_skew < 0:
         raise ValueError(f"tenant_skew must be >= 0, got {tenant_skew}")
+    if adapters < 0:
+        raise ValueError(f"adapters must be >= 0, got {adapters}")
+    if adapter_skew < 0:
+        raise ValueError(f"adapter_skew must be >= 0, got {adapter_skew}")
     if prefix_families < 1:
         raise ValueError(f"prefix_families must be >= 1, got {prefix_families}")
     long_every = round(1 / long_prompt_frac) if long_prompt_frac > 0 else 0
@@ -2147,6 +2383,12 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
     if tenants:
         w = 1.0 / np.arange(1, tenants + 1, dtype=np.float64) ** tenant_skew
         tenant_p = w / w.sum()
+    adapter_p = None
+    adapter_rs = np.random.RandomState(seed + 0x5A)   # independent stream
+    if adapters:
+        wa = 1.0 / np.arange(1, adapters + 1,
+                             dtype=np.float64) ** adapter_skew
+        adapter_p = wa / wa.sum()
     t = 0.0
     trace = []
     for i in range(num_requests):
@@ -2170,6 +2412,9 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         })
         if tenant_p is not None:
             trace[-1]["tenant"] = trace_tenant
+        if adapter_p is not None:
+            trace[-1]["adapter"] = \
+                f"a{int(adapter_rs.choice(adapters, p=adapter_p))}"
     return trace
 
 
@@ -2239,7 +2484,8 @@ def run_trace(engine: ServeEngine, trace: List[dict],
                             arrival_block=item.get("arrival_block", 0),
                             ttft_deadline_ms=item.get("ttft_deadline_ms"),
                             deadline_ms=item.get("deadline_ms"),
-                            tenant=item.get("tenant", "default"))
+                            tenant=item.get("tenant", "default"),
+                            adapter=item.get("adapter"))
         rid = out.request_id if isinstance(out, Rejected) else out
         tenant_of[rid] = item.get("tenant", "default")
     t0 = time.perf_counter()
@@ -2357,6 +2603,23 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             completions, tok_ts, wall_s,
             [tenant_of.get(r.request_id, "default")
              for r in engine.rejected])
+    if getattr(engine, "lora", False):
+        # multi-LoRA surface: pool residency + the load/evict/repair cycle
+        # — the "one compiled program, any adapter mix" evidence
+        pool = engine.session.adapters
+        report.update({
+            "multilora": True,
+            "adapter_slots": pool.n_slots,
+            "adapters_resident": sorted(pool.resident),
+            "adapter_loads": pool.stats["loads"],
+            "adapter_evictions": pool.stats["evictions"],
+            "adapter_hits": pool.stats["hits"],
+            "adapter_repairs": pool.stats["repairs"],
+            "adapter_load_failures": pool.stats["load_failures"],
+            "adapter_rejects": engine.stats["adapter_rejects"],
+            "adapter_load_retries": engine.stats["adapter_load_retries"],
+            "adapter_bytes_per_slot": pool.adapter_bytes(),
+        })
     if engine._injector is not None:
         report["fault_stats"] = dict(engine._injector.stats)
     pkv = getattr(engine.session, "paged", None)

@@ -34,6 +34,14 @@ in the same order, so "replay the storm" is a one-line reproducer:
   or the replica's last snapshot — streams stay bit-identical because
   token t of request r draws ``fold_in(fold_in(base, r), t)`` regardless
   of which replica serves it.
+* **adapter** (``FaultInjector.on_adapter_acquire``) — per adapter-pool
+  acquire, the load may FAIL outright (``adapter_load_fail_prob`` — the
+  admission requeues and retries at a later block) or the adapter's DEVICE
+  bytes may be physically garbled first (``adapter_corrupt_prob`` — the
+  pool's per-adapter checksum catches it and repairs from the host
+  registry). Either way the request is only ever served under its OWN,
+  intact adapter: an adapter fault is a latency event, never a silent
+  wrong-adapter token — which the multi-LoRA chaos tests assert.
 * **tier** (``FaultInjector.on_tier_restore``) — per host-tier page read,
   the restore may FAIL outright (``tier_restore_fail_prob`` — an IO error:
   the entry is dropped, the admission re-prefills the suffix) or the tier
@@ -84,11 +92,14 @@ class FaultPlan:
     max_replica_crashes: int = 1
     tier_restore_fail_prob: float = 0.0
     tier_corrupt_prob: float = 0.0
+    adapter_load_fail_prob: float = 0.0
+    adapter_corrupt_prob: float = 0.0
 
     def __post_init__(self):
         for name in ("pool_exhaust_prob", "dispatch_fail_prob",
                      "corrupt_page_prob", "replica_crash_prob",
-                     "tier_restore_fail_prob", "tier_corrupt_prob"):
+                     "tier_restore_fail_prob", "tier_corrupt_prob",
+                     "adapter_load_fail_prob", "adapter_corrupt_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -96,6 +107,10 @@ class FaultPlan:
             raise ValueError(
                 "tier_restore_fail_prob + tier_corrupt_prob must be <= 1 "
                 "(one verdict per restore)")
+        if self.adapter_load_fail_prob + self.adapter_corrupt_prob > 1.0:
+            raise ValueError(
+                "adapter_load_fail_prob + adapter_corrupt_prob must be <= 1 "
+                "(one verdict per acquire)")
         if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
             raise ValueError("storm lengths must be >= 1")
         if self.max_replica_crashes < 0:
@@ -130,14 +145,16 @@ class FaultInjector:
         self._rs = {
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
-            for seam in ("alloc", "dispatch", "corrupt", "replica", "tier")
+            for seam in ("alloc", "dispatch", "corrupt", "replica", "tier",
+                         "adapter")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
         self._replica_crashes_done = 0
         self.stats = {"alloc_faults": 0, "dispatch_faults": 0,
                       "pages_corrupted": 0, "replica_crashes": 0,
-                      "tier_restore_faults": 0, "tier_corruptions": 0}
+                      "tier_restore_faults": 0, "tier_corruptions": 0,
+                      "adapter_load_faults": 0, "adapter_corruptions": 0}
 
     # --- allocator seam --------------------------------------------------
 
@@ -216,6 +233,29 @@ class FaultInjector:
             return "fail"
         if u < frp + tcp:
             self.stats["tier_corruptions"] += 1
+            return "corrupt"
+        return None
+
+    # --- adapter seam ----------------------------------------------------
+
+    def on_adapter_acquire(self) -> Optional[str]:
+        """Called by ``AdapterPool.acquire`` before each pin: one draw
+        decides the verdict — ``'fail'`` (load IO error: the admission
+        requeues and retries a later block), ``'corrupt'`` (the resident
+        slot's device bytes are garbled; the pool's checksum catches it and
+        repairs from the host registry), or None. One draw per acquire
+        keeps the seam's schedule independent of which verdict fired —
+        the same discipline as the tier seam."""
+        flp = self.plan.adapter_load_fail_prob
+        acp = self.plan.adapter_corrupt_prob
+        if not (flp or acp):
+            return None
+        u = self._rs["adapter"].random_sample()
+        if u < flp:
+            self.stats["adapter_load_faults"] += 1
+            return "fail"
+        if u < flp + acp:
+            self.stats["adapter_corruptions"] += 1
             return "corrupt"
         return None
 

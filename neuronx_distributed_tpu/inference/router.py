@@ -310,11 +310,19 @@ class Router:
 
     # --- submission -------------------------------------------------------
 
+    def register_adapter(self, name: str, lora_params, lora_config) -> None:
+        """Register a LoRA adapter fleet-wide (every replica's pool learns
+        the host bytes; device residency stays per-replica — which is what
+        adapter-affinity placement keys on)."""
+        for eng in self.engines:
+            eng.register_adapter(name, lora_params, lora_config)
+
     def submit(self, prompt, max_new_tokens: int, *,
                tenant: str = "default", sampler=None,
                eos_token_id: Optional[int] = None, arrival_block: int = 0,
                ttft_deadline_ms: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> Union[int, Rejected]:
+               deadline_ms: Optional[float] = None,
+               adapter: Optional[str] = None) -> Union[int, Rejected]:
         """Queue a request with the router (placement happens at block
         boundaries); returns its globally-unique id, or a structured
         :class:`Rejected` when tenant-aware shedding refuses it. Deadlines
@@ -324,6 +332,7 @@ class Router:
         probe = self.engines[0]
         prompt, sampler, greedy = probe._validate_submit(
             prompt, max_new_tokens, sampler)
+        probe._validate_adapter(adapter)
         rid = self._next_id
         self._next_id += 1
         req = Request(
@@ -337,6 +346,7 @@ class Router:
             deadline_block=probe._deadline_block(
                 arrival_block, deadline_ms, "deadline_ms"),
             tenant=str(tenant),
+            adapter=adapter,
         )
         t = self._tenant(req.tenant)
         t.submitted += 1
@@ -458,10 +468,18 @@ class Router:
 
     def _load_score(self, i: int, req: Request) -> Tuple:
         """Least-loaded / deadline-aware ordering key (smaller is better):
-        estimated TTFT in blocks first (0 with a free slot + pool room,
-        else the soonest retirement estimate plus the queued backlog),
-        then backlog depth, then fewest pages in use."""
+        ADAPTER AFFINITY first — a replica whose pool already holds the
+        request's adapter beats every cold one (the prefix-affinity
+        economics applied to adapter loads: a resident hit costs nothing,
+        a cold load pays the device write and may evict a neighbour's hot
+        adapter) — then estimated TTFT in blocks (0 with a free slot +
+        pool room, else the soonest retirement estimate plus the queued
+        backlog), then backlog depth, then fewest pages in use."""
         eng = self.engines[i]
+        adapter_miss = 0
+        if req.adapter is not None and getattr(eng, "lora", False):
+            adapter_miss = 0 if eng.session.adapters.is_resident(
+                req.adapter) else 1
         free = len(eng._free_slots())
         backlog = (len(eng.queue) + len(eng._prefilling)
                    + len(eng._replay_q))
@@ -472,7 +490,7 @@ class Router:
             est_ttft = eng._pool_retry_after() + backlog
         pages = (eng.session.paged.allocator.in_use()
                  if eng.paged and eng.session.paged is not None else 0)
-        return (est_ttft, backlog, -free, pages, i)
+        return (adapter_miss, est_ttft, backlog, -free, pages, i)
 
     def _pick_replica(self, e: _Entry) -> Tuple[Optional[int], int]:
         """Choose a replica for one entry; returns (replica, prefix_hit
@@ -877,6 +895,11 @@ class Router:
                                if eng.paged and eng.session.paged is not None
                                and eng.session.paged.tier is not None
                                else None),
+                # device-resident adapters (None without a multi-LoRA pool):
+                # the state adapter-affinity placement keys on
+                "adapters_resident": (
+                    sorted(eng.session.adapters.resident)
+                    if getattr(eng, "lora", False) else None),
             })
         return out
 
@@ -897,7 +920,8 @@ def run_router_trace(router: Router, trace: List[dict],
                       arrival_block=item.get("arrival_block", 0),
                       ttft_deadline_ms=item.get("ttft_deadline_ms"),
                       deadline_ms=item.get("deadline_ms"),
-                      tenant=item.get("tenant", "default"))
+                      tenant=item.get("tenant", "default"),
+                      adapter=item.get("adapter"))
     t0 = time.perf_counter()
     completions = router.run(max_blocks=max_blocks)
     wall_s = time.perf_counter() - t0
@@ -971,6 +995,24 @@ def run_router_trace(router: Router, trace: List[dict],
                 p.stats["tier_restore_failures"] for p in tiered),
             "tier_repaired_pages": sum(
                 p.stats["tier_repaired_pages"] for p in tiered),
+        })
+    lora_engines = [eng for eng in router.engines
+                    if getattr(eng, "lora", False)]
+    if lora_engines:
+        # fleet-aggregate multi-LoRA surface (per-replica residency is in
+        # replica_states): loads/evictions/repairs summed across replicas
+        report.update({
+            "multilora": True,
+            "adapter_loads": sum(
+                eng.session.adapters.stats["loads"] for eng in lora_engines),
+            "adapter_evictions": sum(
+                eng.session.adapters.stats["evictions"]
+                for eng in lora_engines),
+            "adapter_repairs": sum(
+                eng.session.adapters.stats["repairs"]
+                for eng in lora_engines),
+            "adapter_rejects": sum(
+                int(eng.stats["adapter_rejects"]) for eng in lora_engines),
         })
     tenants = {item.get("tenant", "default") for item in trace}
     if tenants != {"default"}:

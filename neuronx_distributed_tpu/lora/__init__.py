@@ -1,0 +1,22 @@
+"""LoRA adapters: the public surface of ``lora.core`` (training-side merge/
+attach transforms, adapter-only serving export) — re-exported here so
+callers stop reaching into the submodule. The SERVING-side multi-adapter
+pool lives in ``inference/adapters.py`` (built on ``init_lora`` trees)."""
+
+from neuronx_distributed_tpu.lora.core import (  # noqa: F401
+    LoraConfig,
+    attach_adapters,
+    export_merged_hf,
+    init_lora,
+    lora_param_specs,
+    merge_lora,
+)
+
+__all__ = [
+    "LoraConfig",
+    "attach_adapters",
+    "export_merged_hf",
+    "init_lora",
+    "lora_param_specs",
+    "merge_lora",
+]

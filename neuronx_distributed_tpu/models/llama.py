@@ -103,6 +103,19 @@ class LlamaConfig:
     # (that shape equality is what makes paged attention bit-identical).
     page_size: Optional[int] = None
     page_pool_pages: Optional[int] = None
+    # multi-LoRA serving pool (inference/adapters.py, S-LoRA/Punica): every
+    # targeted projection gains per-slot low-rank stacks A (lora_slots,
+    # fan_in, lora_rank) / B (lora_slots, lora_rank, fan_out) + scale on a
+    # READ-ONLY "adapters" flax collection (scanned over layers like the
+    # cache), and the forward adds y += s[i]·(x @ A[i]) @ B[i] with i =
+    # adapter_idx[row] gathered in-program — ONE compiled program serves
+    # any adapter mix. Slot 0 is the identity adapter (B = 0, scale = 0:
+    # the correction is exactly zero). None disables: no variables are
+    # declared and the HLO is byte-identical to the pre-LoRA model.
+    lora_rank: Optional[int] = None
+    lora_slots: int = 0
+    lora_targets: Tuple[str, ...] = ("qkv", "o_proj", "gate_proj",
+                                     "up_proj", "down_proj")
 
     @property
     def head_dim_(self) -> int:
@@ -275,6 +288,37 @@ def cached_attention(q, k_cache, v_cache, cache_len, sm_scale=None, mask=None):
     return out.astype(q.dtype)
 
 
+def _adapter_idx(mdl: nn.Module, batch: int) -> jax.Array:
+    """Per-slot adapter index ``(b,)`` riding the read-only ``"adapters"``
+    collection exactly like ``cache_index`` rides the cache: the serving
+    host swaps it between blocks (or substitutes a row-width view inside
+    insert programs) without touching any program signature."""
+    return mdl.variable("adapters", "adapter_idx",
+                        lambda: jnp.zeros((batch,), jnp.int32)).value
+
+
+def _lora_pool_delta(mdl: nn.Module, cfg: LlamaConfig, name: str,
+                     x: jax.Array, fan_out: int, idx: jax.Array) -> jax.Array:
+    """Batched per-row LoRA correction ``s[i] · (x @ A[i]) @ B[i]`` with
+    ``i = adapter_idx[row]`` gathered from the device-resident pool stacks
+    (S-LoRA's batched adapter matmul). Stacks live on the ``"adapters"``
+    collection (per-layer under the scan, like every cache leaf) in fp32 —
+    the pool's storage dtype; the caller casts the delta into its own
+    compute dtype. Zero-padded ranks and the identity slot's zero B/scale
+    contribute exactly zero."""
+    pool, r = cfg.lora_slots, cfg.lora_rank
+    a = mdl.variable("adapters", f"lora_{name}_a", jnp.zeros,
+                     (pool, x.shape[-1], r), jnp.float32).value
+    b = mdl.variable("adapters", f"lora_{name}_b", jnp.zeros,
+                     (pool, r, fan_out), jnp.float32).value
+    s = mdl.variable("adapters", f"lora_{name}_scale", jnp.zeros,
+                     (pool,), jnp.float32).value
+    xf = x.astype(jnp.float32)
+    d = jnp.einsum("bsh,bhr->bsr", xf, a[idx])
+    d = jnp.einsum("bsr,bro->bso", d, b[idx])
+    return d * s[idx][:, None, None]
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -295,12 +339,31 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
+        aidx = _adapter_idx(self, x.shape[0]) if cfg.lora_rank else None
+        if aidx is not None and "qkv" in cfg.lora_targets:
+            # per-row pooled corrections on the three fused projections,
+            # applied pre-clip/pre-RoPE (the same point the training-path
+            # attached adapters land, parallel/layers.py add_delta); K/V
+            # deltas are computed COMPACT then head-repeated like the
+            # kernels under kv_size_multiplier
+            b, sq = x.shape[0], x.shape[1]
+            q = q + _lora_pool_delta(self, cfg, "q", x, cfg.num_heads * hd,
+                                     aidx).reshape(q.shape).astype(q.dtype)
+            dk = _lora_pool_delta(self, cfg, "k", x, cfg.num_kv_heads * hd,
+                                  aidx).reshape(b, sq, cfg.num_kv_heads, hd)
+            dv = _lora_pool_delta(self, cfg, "v", x, cfg.num_kv_heads * hd,
+                                  aidx).reshape(b, sq, cfg.num_kv_heads, hd)
+            if cfg.kv_size_multiplier > 1:
+                dk = jnp.repeat(dk, cfg.kv_size_multiplier, axis=2)
+                dv = jnp.repeat(dv, cfg.kv_size_multiplier, axis=2)
+            k = k + dk.astype(k.dtype)
+            v = v + dv.astype(v.dtype)
         if cfg.qkv_clip is not None:  # DBRX clip_qkv (applied pre-RoPE)
             q = jnp.clip(q, -cfg.qkv_clip, cfg.qkv_clip)
             k = jnp.clip(k, -cfg.qkv_clip, cfg.qkv_clip)
             v = jnp.clip(v, -cfg.qkv_clip, cfg.qkv_clip)
         if cfg.decode:
-            return self._decode_attention(x, q, k, v, chunk_ctx)
+            return self._decode_attention(x, q, k, v, chunk_ctx, aidx)
         cos, sin = rope  # computed once in LlamaModel, broadcast through scan
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
@@ -328,17 +391,21 @@ class LlamaAttention(nn.Module):
                 block_k=blk_k,
             )
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
-        return self._o_proj(o)
+        return self._o_proj(o, aidx)
 
-    def _o_proj(self, o):
+    def _o_proj(self, o, aidx=None):
         cfg = self.config
-        return RowParallelLinear(
+        y = RowParallelLinear(
             cfg.hidden_size, use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="o_proj",
         )(o)
+        if aidx is not None and "o_proj" in cfg.lora_targets:
+            y = y + _lora_pool_delta(self, cfg, "o_proj", o, cfg.hidden_size,
+                                     aidx).astype(y.dtype)
+        return y
 
-    def _decode_attention(self, x, q, k, v, chunk_ctx=None):
+    def _decode_attention(self, x, q, k, v, chunk_ctx=None, aidx=None):
         """KV-cached path (flax ``cache`` collection; the reference keeps KV
         state in aliased runtime buffers, model_base.py KV management —
         donation of the cache collection is the TPU analogue)."""
@@ -451,7 +518,7 @@ class LlamaAttention(nn.Module):
             mask = prefix | (in_chunk & tree)
             o = cached_attention(q, k_all, v_all, idx, mask=mask)
             o = o.reshape(b, s_new, -1)
-            return self._o_proj(o)
+            return self._o_proj(o, aidx)
         # prefill/chunk attention: the Pallas kernel with per-slot position
         # masks (q at idx..idx+s_new; key j visible iff j <= q position, which
         # also excludes unwritten cache slots). The reference likewise uses
@@ -483,7 +550,7 @@ class LlamaAttention(nn.Module):
         else:
             o = cached_attention(q, k_all, v_all, idx)
         o = o.reshape(b, s_new, -1)
-        return self._o_proj(o)
+        return self._o_proj(o, aidx)
 
 
 class LlamaMLP(nn.Module):
@@ -492,6 +559,7 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
+        aidx = _adapter_idx(self, x.shape[0]) if cfg.lora_rank else None
         gate = ColumnParallelLinear(
             cfg.intermediate_size, use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
@@ -502,11 +570,25 @@ class LlamaMLP(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="up_proj",
         )(x)
-        return RowParallelLinear(
+        if aidx is not None:
+            if "gate_proj" in cfg.lora_targets:
+                gate = gate + _lora_pool_delta(
+                    self, cfg, "gate_proj", x, cfg.intermediate_size,
+                    aidx).astype(gate.dtype)
+            if "up_proj" in cfg.lora_targets:
+                up = up + _lora_pool_delta(
+                    self, cfg, "up_proj", x, cfg.intermediate_size,
+                    aidx).astype(up.dtype)
+        h = nn.silu(gate) * up
+        y = RowParallelLinear(
             cfg.hidden_size, use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="down_proj",
-        )(nn.silu(gate) * up)
+        )(h)
+        if aidx is not None and "down_proj" in cfg.lora_targets:
+            y = y + _lora_pool_delta(self, cfg, "down_proj", h,
+                                     cfg.hidden_size, aidx).astype(y.dtype)
+        return y
 
 
 class LlamaDecoderLayer(nn.Module):
@@ -568,10 +650,12 @@ class LlamaModel(nn.Module):
         )
         # scan over layers: one compiled body, params stacked on a leading
         # (unsharded) layer axis. "losses" carries per-layer sown aux losses
-        # (MoE variants); unused collections in variable_axes are harmless.
+        # (MoE variants), "adapters" the per-layer LoRA pool stacks (multi-
+        # LoRA serving); unused collections in variable_axes are harmless.
         self.layers = nn.scan(
             _LayerStep,
-            variable_axes={"params": 0, "cache": 0, "losses": 0},
+            variable_axes={"params": 0, "cache": 0, "losses": 0,
+                           "adapters": 0},
             split_rngs={"params": True},
             length=cfg.num_layers,
             in_axes=nn.broadcast,

@@ -20,6 +20,9 @@ non-overlapping phase segments:
 * ``pool_wait``       — admission deferred or unwound by page-pool
   exhaustion (``pool_defer`` / ``prefill_abort`` with requeue), waiting for
   retirements to return pages;
+* ``adapter_load``    — admission blocked on the request's LoRA adapter
+  (``adapter_defer``: an injected/transient load fault requeued it — the
+  blocks until the retrying admission lands are the adapter-load price);
 * ``prefill``         — chunked prefill rounds (``chunk_begin`` to
   ``first_token``); one-shot inserts admit and sample the first token in
   the same block, so their prefill phase is 0 blocks wide by construction;
@@ -50,8 +53,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-PHASES = ("queued", "requeue_backoff", "pool_wait", "prefill", "decode",
-          "corrupt_replay", "failover_replay")
+PHASES = ("queued", "requeue_backoff", "pool_wait", "adapter_load",
+          "prefill", "decode", "corrupt_replay", "failover_replay")
 
 # terminal lifecycle events: the walker closes the open phase here
 _TERMINALS = ("retire", "expire", "cancel", "shed", "reject")
@@ -104,7 +107,8 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
     term_args: dict = {}
     submit_args: dict = {}
     annotations = {"prefill_chunks": 0, "requeues": 0, "pool_defers": 0,
-                   "tier_restored_pages": 0, "replays": 0}
+                   "tier_restored_pages": 0, "replays": 0,
+                   "adapter_defers": 0, "adapter_loads": 0}
 
     def close(upto_block, upto_ts, name=None):
         """Charge [cur, upto_block] to ``name`` (default: the open phase)
@@ -150,6 +154,12 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
             close(blk, ts)
             phase = "pool_wait"
             annotations["pool_defers"] += 1
+        elif name == "adapter_defer":
+            close(blk, ts)
+            phase = "adapter_load"
+            annotations["adapter_defers"] += 1
+        elif name == "adapter_load":
+            annotations["adapter_loads"] += 1
         elif name == "chunk_begin":
             close(blk, ts)
             phase = "prefill"

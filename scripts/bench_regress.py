@@ -58,6 +58,7 @@ RULES: List[Tuple[str, str, float]] = [
     # explicit ratios whose direction the name alone cannot tell
     (r"serve_tracing_overhead_ratio", "higher", 0.03),
     (r"serve_goodput_2x_vs_1x", "higher", 0.10),
+    (r"serve_multilora_vs_merged", "higher", 0.10),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better

@@ -74,6 +74,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_tenant_p99_fairness_ratio": 1.08,
                                       "serve_failover_replay_ms": 145.0,
                                       "serve_drain_ms": 96.0,
+                                      "serve_tokens_per_sec_multilora": 481.0,
+                                      "serve_tokens_per_sec_merged_single": 503.0,
+                                      "serve_multilora_vs_merged": 0.956,
+                                      "adapter_switch_overhead_ms": 3.4,
+                                      "adapter_acquire_hit_ms": 0.2,
+                                      "adapter_bytes_per_slot": 13371392,
                                       "serve_tracing_overhead_ratio": 0.993,
                                       "serve_tokens_per_sec_traced": 508.4,
                                       "serve_tokens_per_sec_untraced": 512.0,
@@ -162,6 +168,17 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_tenant_p99_fairness_ratio"] <= 1.2
     assert h["serve_failover_replay_ms"] == 145.0
     assert h["serve_drain_ms"] == 96.0
+    # multi-LoRA keys (ISSUE 10): the mixed 8-adapter trace must hold >=
+    # 0.9x the single-merged baseline, the switch-overhead price tag rides
+    # the headline next to it; raw baseline tok/s and the pool sizing unit
+    # stay sidecar-only
+    assert d["serve_tokens_per_sec_multilora"] == \
+        h["serve_tokens_per_sec_multilora"] == 481.0
+    assert h["serve_multilora_vs_merged"] >= 0.9
+    assert h["adapter_switch_overhead_ms"] == 3.4
+    assert h["adapter_switch_overhead_ms"] > d["adapter_acquire_hit_ms"]
+    assert "serve_tokens_per_sec_merged_single" not in h
+    assert "adapter_bytes_per_slot" not in h
     # observability keys (ISSUE 6): the tracing-overhead ratio rides the
     # headline and must clear the zero-cost gate; the per-program compile
     # timing dict is sidecar-only (long keys stay out of the tail capture)

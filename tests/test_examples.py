@@ -274,6 +274,33 @@ def test_inference_runner_serve_replicas_crash_failover_tiny(capsys):
     assert sum(row["requests"] for row in report["per_tenant"].values()) == 6
 
 
+def test_inference_runner_serve_multilora_tiny(capsys):
+    """ISSUE 10 CI gate: runner.py serve --adapters drives the multi-LoRA
+    pool through the CLI — 3 Zipf-labeled adapters share ONE base model
+    through a 2-slot pool (identity + 1), so serving the trace forces
+    load/evict churn and one concurrent-adapter admission is shed with the
+    structured adapter_pool_exhausted verdict; everything that admitted
+    completes its full budget and the report carries the adapter surface."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "6",
+                 "--max_new_tokens", "4", "--fused_steps", "3",
+                 "--adapters", "3", "--adapter_rank", "4",
+                 "--adapter_pool_slots", "2", "--adapter_skew", "0.0",
+                 "--mean_interarrival", "2.0"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["multilora"] is True and report["adapter_slots"] == 2
+    assert report["requests_completed"] + report["rejected"] == 6
+    assert report["total_generated_tokens"] == \
+        report["requests_completed"] * 4
+    assert report["host_ops_per_block"] == 2.0   # decode contract untouched
+    assert report["adapter_loads"] >= 2          # >= 2 distinct adapters
+    assert report["adapter_evictions"] >= 1      # pool churn happened
+    assert report["adapter_rejects"] == report["rejected"]
+    assert report["adapter_load_failures"] == 0
+    assert report["adapter_bytes_per_slot"] > 0
+
+
 def test_inference_runner_serve_trace_and_metrics_out(capsys, tmp_path):
     """ISSUE 6 CI gate: runner.py serve --trace_out/--metrics_out writes
     BOTH observability artifacts — the trace loads as valid Chrome

@@ -556,3 +556,84 @@ def test_engine_trace_drop_counter(lm):
     eng.run()
     assert tr.dropped > 0
     assert eng.metrics.counter("trace_dropped_events").value == tr.dropped
+
+
+# ---------------------------------------------- multi-LoRA lanes (ISSUE 10)
+
+def test_multilora_observability_lanes_and_attribution():
+    """ISSUE 10 observability satellite, pinned on one tiny lora engine:
+
+    * pool lifecycle instants (``adapter:load/pin/evict``) land on the
+      ``("cache", "adapter")`` lane and the ``adapter_pool_pages`` counter
+      track rides the schema-valid Chrome export;
+    * ``request_timeline`` shows the ``adapter_load`` mark inside the
+      admission (between the queued span and first_token);
+    * an injected adapter-load fault becomes an ``adapter_load`` phase in
+      the attribution — and the phase-sum == e2e invariant (asserted
+      inside ``request_attribution``) stays exact with the new phase.
+    """
+    from neuronx_distributed_tpu.inference.faults import FaultPlan
+    from neuronx_distributed_tpu.lora import LoraConfig, init_lora
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    cfg = LlamaConfig(**TINY)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(
+        LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0), ids0))["params"]
+    lm_l = CausalLM(cfg, params, LlamaForCausalLM, buckets=(8,),
+                    max_batch=2, lora_rank=2, lora_slots=2).compile()
+    acfg = LoraConfig(r=2, lora_alpha=4.0)
+
+    def mk(i):
+        ad = init_lora(params, acfg, jax.random.key(30 + i))
+        return {k: {"lora_a": v["lora_a"],
+                    "lora_b": 0.05 * jax.random.normal(
+                        jax.random.fold_in(jax.random.key(40 + i), j),
+                        v["lora_b"].shape, jnp.float32)}
+                for j, (k, v) in enumerate(sorted(ad.items()))}
+
+    adapters = {f"a{i}": mk(i) for i in range(2)}
+    eng = ServeEngine(lm_l, block_steps=K, trace=True,
+                      rng=jax.random.key(42))
+    for n, ad in adapters.items():
+        eng.register_adapter(n, ad, acfg)
+    p = _prompts(2, seed=21)
+    r0 = eng.submit(p[0], 4, adapter="a0")
+    # a1 arrives after a0 retires: its load must EVICT a0 (1 usable slot)
+    r1 = eng.submit(p[1], 4, adapter="a1", arrival_block=6)
+    eng.run()
+    names = {ev["name"] for ev in eng.tracer.events()
+             if ev["lane"] == ("cache", "adapter")}
+    assert {"adapter:load", "adapter:pin", "adapter:evict"} <= names
+    counters = {ev["name"] for ev in eng.tracer.events() if ev["ph"] == "C"}
+    assert "adapter_pool_pages" in counters
+    # Chrome export stays schema-valid with the new lanes
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", mode="r+") as f:
+        eng.tracer.export_chrome(f.name)
+        summary = validate_chrome_trace(json.load(open(f.name)))
+    assert summary["events"] > 0
+    # request_timeline: the adapter-load mark sits inside the admission
+    tl = [e["name"] for e in eng.request_timeline(r1)]
+    assert "adapter_load" in tl
+    assert tl.index("adapter_load") < tl.index("first_token")
+    # registry surface
+    assert eng.metrics.gauge("serve_adapter_slots_in_use").value == 1
+    assert eng.session.adapters.stats["evictions"] == 1
+
+    # injected load fault -> adapter_load phase, phase sum stays exact
+    # (seed 8's first two adapter draws are 'fail' at p=0.3)
+    eng_f = ServeEngine(lm_l, block_steps=K, trace=True,
+                        rng=jax.random.key(42),
+                        faults=FaultPlan(seed=8, adapter_load_fail_prob=0.3))
+    for n, ad in adapters.items():
+        eng_f.register_adapter(n, ad, acfg)
+    rf = eng_f.submit(p[0], 4, adapter="a0")
+    eng_f.run()
+    assert eng_f.stats["adapter_load_retries"] >= 1
+    att = eng_f.request_attribution(rf)   # internal assert: sum == e2e
+    assert att["phases_blocks"].get("adapter_load", 0) >= 1
+    assert att["annotations"]["adapter_defers"] >= 1
+    assert att["annotations"]["adapter_loads"] == 1
+    assert att["terminal"] == "retire"

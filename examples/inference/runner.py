@@ -538,16 +538,46 @@ def cmd_serve(args) -> None:
         # per-tenant WFQ, heartbeat failover, graceful drain.
         # --crash_replica_at B injects one replica crash (the last
         # replica) at router block B: the CI smoke's failover gate.
+        # --disagg splits the fleet into roles (DisaggRouter): the first
+        # --prefill_replicas workers run only insert/extend programs and
+        # hand finished KV pages to the decode workers through checksummed
+        # handoffs — decode ITL with ZERO prefill sharing.
         crash_at = ([(args.crash_replica_at, args.replicas - 1)]
                     if args.crash_replica_at is not None else ())
-        router = Router(lm, args.replicas, rng=jax.random.key(args.seed),
-                        crash_at=crash_at,
-                        faults=resolve_fault_plan(args.fault_plan),
-                        **eng_kw)
-        if adapter_reg:
-            for n, ad in adapter_reg.items():
-                router.register_adapter(n, ad, adapter_cfg)
-        report = run_router_trace(router, trace)
+        if args.disagg:
+            from neuronx_distributed_tpu.inference.disagg import (
+                DisaggRouter, run_disagg_trace,
+            )
+
+            if not lm.paged:
+                raise SystemExit("--disagg requires --paged (the handoff "
+                                 "moves KV as physical pages)")
+            # warm the whole migration path (insert widths, the fused
+            # block, AND the adoption-side page-write programs) outside
+            # the measured run — cmd_generate's discipline; the decode
+            # clock must time steady-state blocks, not first-call compiles
+            warm_r = DisaggRouter(
+                lm, 2, prefill_replicas=1,
+                block_steps=args.fused_steps, fused=not args.stepwise,
+                rng=jax.random.key(args.seed))
+            for item in trace[: min(len(trace), lm.max_batch)]:
+                warm_r.submit(item["prompt"], 2)
+            warm_r.run(max_blocks=200)
+            del warm_r
+            router = DisaggRouter(
+                lm, args.replicas, prefill_replicas=args.prefill_replicas,
+                rng=jax.random.key(args.seed), crash_at=crash_at,
+                faults=resolve_fault_plan(args.fault_plan), **eng_kw)
+            report = run_disagg_trace(router, trace)
+        else:
+            router = Router(lm, args.replicas, rng=jax.random.key(args.seed),
+                            crash_at=crash_at,
+                            faults=resolve_fault_plan(args.fault_plan),
+                            **eng_kw)
+            if adapter_reg:
+                for n, ad in adapter_reg.items():
+                    router.register_adapter(n, ad, adapter_cfg)
+            report = run_router_trace(router, trace)
         if args.trace_out:
             router.tracer.export_chrome(args.trace_out)
         if args.metrics_out:
@@ -809,6 +839,18 @@ def main(argv=None) -> None:
                             "behind the Router front door (prefix-affinity "
                             "placement, per-tenant WFQ, heartbeat failover, "
                             "graceful drain) over one shared model")
+        p.add_argument("--disagg", action="store_true",
+                       help="serve --replicas N --paged: prefill/decode "
+                            "disaggregation — the first --prefill_replicas "
+                            "workers run prefill only and hand finished KV "
+                            "pages to the decode workers through "
+                            "checksummed handoffs (decode ITL with zero "
+                            "prefill sharing; streams bit-identical to a "
+                            "single engine)")
+        p.add_argument("--prefill_replicas", type=int, default=1,
+                       help="serve --disagg: how many of the N replicas "
+                            "are dedicated prefill workers (the rest run "
+                            "the fused decode scan + page adoption)")
         p.add_argument("--tenants", type=int, default=0,
                        help="serve: label trace requests with this many "
                             "tenants, Zipf-skewed (t0 is the heavy hitter); "

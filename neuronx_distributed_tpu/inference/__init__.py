@@ -28,6 +28,11 @@ from neuronx_distributed_tpu.inference.router import (  # noqa: F401
     Router,
     run_router_trace,
 )
+from neuronx_distributed_tpu.inference.disagg import (  # noqa: F401
+    DisaggRouter,
+    KVHandoff,
+    run_disagg_trace,
+)
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel  # noqa: F401
 from neuronx_distributed_tpu.inference.paged_cache import (  # noqa: F401
     PageAllocator,

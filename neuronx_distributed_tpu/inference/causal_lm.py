@@ -59,6 +59,24 @@ def _set_cache_index(cache: PyTree, lengths: jax.Array) -> PyTree:
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def _set_cache_index_rows(cache: PyTree, slot_ids, lengths) -> PyTree:
+    """Overwrite the cache_index entries of ``slot_ids`` ONLY (stacked
+    (L, b) leaves) — the page-adoption install's targeted variant of
+    ``_set_cache_index``: a migrated stream's slot must start decoding at
+    its prompt length while every other slot's device counter (which the
+    compiled programs advance) stays untouched."""
+
+    def fix(path, leaf):
+        if not jax.tree_util.keystr(path).endswith("['cache_index']"):
+            return leaf
+        out = leaf
+        for s, v in zip(slot_ids, lengths):
+            out = out.at[:, int(s)].set(jnp.asarray(int(v), leaf.dtype))
+        return out
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def _merge_cache_slots(old: PyTree, new: PyTree, sel: jax.Array,
                        new_len: jax.Array) -> PyTree:
     """Full-width cache merge (the pre-scatter insert path, kept as the

@@ -117,7 +117,11 @@ from neuronx_distributed_tpu.inference.adapters import (
     AdapterLoadError,
     AdapterPoolExhausted,
 )
-from neuronx_distributed_tpu.inference.causal_lm import CausalLM, _set_block_tables
+from neuronx_distributed_tpu.inference.causal_lm import (
+    CausalLM,
+    _set_block_tables,
+    _set_cache_index_rows,
+)
 from neuronx_distributed_tpu.inference.faults import (
     DispatchFailed,
     FaultInjector,
@@ -224,6 +228,7 @@ _STAT_KEYS = (
     "dispatch_retries", "corrupt_page_replays", "restored_requests",
     "tier_page_repairs",
     "adapter_rejects", "adapter_load_retries",
+    "handoffs_sent", "handoffs_adopted",
 )
 
 
@@ -319,7 +324,16 @@ class ServeEngine:
         incident_window_blocks: int = 16,
         incident_burst_threshold: int = 3,
         incident_burst_window: int = 8,
+        role: str = "both",
     ):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got {role!r}")
+        if role != "both" and not getattr(lm, "paged", False):
+            raise ValueError(
+                "disaggregated roles require a paged CausalLM — the "
+                "prefill→decode handoff moves KV as physical pages "
+                "(inference/disagg.py)")
         if block_steps < 1:
             raise ValueError(f"block_steps must be >= 1, got {block_steps}")
         if prefill_chunk_tokens < 0:
@@ -352,6 +366,14 @@ class ServeEngine:
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
+        # prefill/decode disaggregation role (inference/disagg.py): a
+        # "prefill" worker runs ONLY insert/extend programs — a finished
+        # prompt's first token is sampled here, its KV pages are packaged
+        # into a checksummed KVHandoff (self.outbox) and the slot is
+        # released; a "decode" worker runs only the fused decode scan plus
+        # page adoption (adopt_handoff). "both" is the classic engine.
+        self.role = role
+        self.outbox: List = []
         self.prefill_chunk_tokens = int(prefill_chunk_tokens)
         self.slot_sampler = SlotSampler(top_k=top_k, top_p=top_p)
         self.pad_token_id = int(pad_token_id)
@@ -401,6 +423,11 @@ class ServeEngine:
         self._m_dropped = self.metrics.counter(
             "trace_dropped_events",
             help="tracer ring-buffer events dropped (export is partial)")
+        # decode-worker adoption cost (checksum verify + page alloc + device
+        # writes) — the migration price tag next to serve_tier_restore_ms
+        self._m_handoff = self.metrics.histogram(
+            "serve_handoff_adopt_ms",
+            help="migrated-prompt page adoption wall ms", lo=0.01)
         # SLO burn-rate monitor (observability/slo.py): declarative
         # objectives evaluated once per block; None (the default) costs
         # nothing — the monitor is never constructed
@@ -616,6 +643,10 @@ class ServeEngine:
         """Queue an already-validated :class:`Request` (the Router's
         placement path — deadlines arrive as ABSOLUTE blocks on the shared
         clock, so a router-queued wait never silently extends a budget)."""
+        if self.role == "decode":
+            raise ValueError(
+                "a decode worker admits streams via adopt_handoff/resume "
+                "only — fresh work goes to a prefill worker")
         self._next_id = max(self._next_id, req.request_id + 1)
         now = time.perf_counter()
         self._submit_ts[req.request_id] = now
@@ -840,8 +871,11 @@ class ServeEngine:
         if not self.paged:
             return True
         pkv = self.session.paged
+        # a prefill worker never decodes: its footprint is the prompt pages
+        # only (the decode reserve is the ADOPTING worker's cost)
         need = pkv.pages_needed(prompt_len,
-                                max_new_tokens + self.block_steps)
+                                0 if self.role == "prefill"
+                                else max_new_tokens + self.block_steps)
         free = pkv.allocator.available()
         if free < need and pkv.prefix is not None:
             free += pkv.prefix.reclaimable_pages()
@@ -1282,9 +1316,14 @@ class ServeEngine:
             lens[i] = r.prompt.size
         # paged mode reserves pages for the decode room only (budget + one
         # block of post-budget overrun writes, which land in owned pages or
-        # scratch — never a neighbour); the contiguous path ignores the kwarg
+        # scratch — never a neighbour); the contiguous path ignores the
+        # kwarg. A prefill worker reserves NOTHING beyond the prompt — its
+        # first-token sample writes no KV and the decode room is allocated
+        # by the adopting decode worker.
         reserve = np.asarray(
-            [r.max_new_tokens + self.block_steps for r in group], np.int64)
+            [0 if self.role == "prefill"
+             else r.max_new_tokens + self.block_steps for r in group],
+            np.int64)
         aslots = (np.asarray([self._adapter_slot(r) for r in group], np.int32)
                   if self.lora else None)
         tier_before = self._tier_marker()
@@ -1325,6 +1364,11 @@ class ServeEngine:
             self._gen_counts[slot] = 1
             self._adapter_idx[slot] = 0 if aslots is None else aslots[i]
             self._record(slot, int(first[i]), now)
+        if self.role == "prefill":
+            # disaggregation: the prompt's KV is done and its first token
+            # sampled — hand the pages to the decode pool and free the slot
+            # (streams finished AT the first token retire locally instead)
+            self._handoff_group(list(slot_ids))
 
     # --- chunked prefill (the stall-free admission path) ------------------
 
@@ -1336,9 +1380,10 @@ class ServeEngine:
         written = 0
         if self.paged:
             tier_before = self._tier_marker()
+            reserve = (0 if self.role == "prefill"
+                       else req.max_new_tokens + self.block_steps)
             chunk = self.session.paged.begin_chunked(
-                req.prompt.tolist(),
-                req.prompt.size + req.max_new_tokens + self.block_steps)
+                req.prompt.tolist(), req.prompt.size + reserve)
             written = chunk.start           # prefix hit: skip reused pages
             self._note_tier_restore([req], tier_before)
         req.start_block = self.blocks
@@ -1441,6 +1486,8 @@ class ServeEngine:
         self._tok[slot] = first
         self._gen_counts[slot] = 1
         self._record(slot, first, time.perf_counter())
+        if self.role == "prefill":
+            self._handoff_group([slot])
 
     def _abort_prefill(self, slot: int, requeue: bool) -> None:
         """Atomically unwind an in-flight chunked admission: pages released,
@@ -1640,6 +1687,55 @@ class ServeEngine:
         self.session.cache = jax.tree_util.tree_map_with_path(
             fix, self.session.cache)
 
+    def _io_pad(self, pages: List[int]) -> List[int]:
+        """Pad a page-id list to the slot's full page count by REPEATING
+        the last id: the batched gather/scatter then compiles exactly ONE
+        program shape per leaf — a variable-length handoff would compile a
+        new program per distinct prompt size, and that compile would land
+        mid-run as a decode-clock spike. A duplicate index in a scatter
+        rewrites the same page with the same bytes — safe; in a gather it
+        fetches redundant rows the caller slices off."""
+        n = self.session.paged.pages_per_slot
+        return list(pages) + [pages[-1]] * (n - len(pages))
+
+    def _read_pages_bytes(self, pages: List[int]) -> List[Dict[str, np.ndarray]]:
+        """Batched :meth:`_read_page_bytes`: ONE gather + fetch per K/V
+        leaf for the whole page list, split back into the per-page dicts
+        the handoff's per-page crc framing wants — a 16-page handoff costs
+        2 host ops per leaf instead of 16."""
+        idx = jnp.asarray(self._io_pad(pages), jnp.int32)
+        out: List[Dict[str, np.ndarray]] = [{} for _ in pages]
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.session.cache)[0]:
+            p = jax.tree_util.keystr(path)
+            if (p.endswith("['cached_key']")
+                    or p.endswith("['cached_value']")):
+                arr = np.asarray(leaf[:, idx])       # (L, n_pad, page, kv, hd)
+                for i in range(len(pages)):
+                    out[i][p] = arr[:, i]
+        return out
+
+    def _write_pages_bytes(self, pages: List[int],
+                           datas: List[Dict[str, np.ndarray]]) -> None:
+        """Batched :meth:`_write_page_bytes`: one functional update per
+        K/V leaf for the whole page list — the adoption path's device
+        write (a per-page ``at[].set`` would copy the whole pool once PER
+        PAGE; this copies it once per leaf)."""
+        idx = jnp.asarray(self._io_pad(pages), jnp.int32)
+        pad = len(idx) - len(pages)
+
+        def fix(path, leaf):
+            p = jax.tree_util.keystr(path)
+            if p in datas[0]:
+                stacked = jnp.stack(
+                    [jnp.asarray(d[p], leaf.dtype) for d in datas]
+                    + [jnp.asarray(datas[-1][p], leaf.dtype)] * pad, axis=1)
+                return leaf.at[:, idx].set(stacked)
+            return leaf
+
+        self.session.cache = jax.tree_util.tree_map_with_path(
+            fix, self.session.cache)
+
     def _corrupt_page_bytes(self, pages: List[int]) -> None:
         """Physically garble the K/V pool bytes of ``pages`` in every layer.
         The injected fault is REAL — the recovery replay is thereby proven
@@ -1742,6 +1838,142 @@ class ServeEngine:
             state=self.state_summary(),
             slo=self.slo_status())
 
+    # --- prefill/decode disaggregation: KV-page handoff ------------------
+    # A prefill worker's product is (first token, prompt KV pages); a
+    # decode worker's admission path is page ADOPTION. Both ends move bytes
+    # through the PR 8 page-IO closures (_read_page_bytes/_write_page_bytes)
+    # with HostPageTier's crc32 framing, so a corrupted transfer is caught
+    # by checksum and degrades to a local re-prefill — never a wrong token
+    # (the per-request rng contract again). See inference/disagg.py for the
+    # router-side choreography.
+
+    def _handoff_group(self, slot_ids: List[int]) -> None:
+        """Package each freshly-prefilled slot's prompt pages into a sealed
+        :class:`~neuronx_distributed_tpu.inference.disagg.KVHandoff` on
+        ``self.outbox`` and release the slot (pages read out BEFORE retire
+        frees them). Slots already done — the budget was 1 token, or EOS
+        landed on the first sample — keep their state and retire locally
+        with a normal completion: there is nothing left to decode."""
+        from neuronx_distributed_tpu.inference.disagg import KVHandoff
+
+        pkv = self.session.paged
+        ps = pkv.page_size
+        for slot in slot_ids:
+            req = self.slots[slot]
+            if req is None or self._done[slot]:
+                continue
+            rid = req.request_id
+            n_copy = -(-req.prompt.size // ps)
+            pages = [int(p) for p in pkv.tables[slot][:n_copy]]
+            payloads = self._read_pages_bytes(pages)
+            first = int(self._out[rid][0])
+            ts_list = self._out_ts.get(rid) or [time.perf_counter()]
+            h = KVHandoff(req=req, first_token=first,
+                          first_ts=float(ts_list[0]), page_size=ps,
+                          payloads=payloads)
+            h.seal()
+            self.outbox.append(h)
+            self.stats["handoffs_sent"] += 1
+            if self.tracer.enabled:
+                now = time.perf_counter()
+                self.tracer.instant(
+                    "migrate_send", ("req", rid), block=self.blocks, ts=now,
+                    args={"pages": n_copy,
+                          "prompt_len": int(req.prompt.size)})
+                self.tracer.instant(
+                    "migrate:send", (self.lane, "migrate"),
+                    block=self.blocks,
+                    args={"rid": rid, "pages": n_copy})
+            # the stream now lives in the handoff: free the slot (prompt
+            # pages registered in the prefix index stay resident, so this
+            # worker's radix keeps the prefix hot for future admissions)
+            self.lm.retire(self.session, np.asarray([slot], np.int32))
+            self.slots[slot] = None
+            self._active[slot] = False
+            self._done[slot] = False
+            self._out.pop(rid, None)
+            self._out_ts.pop(rid, None)
+            self._last_tok_ts.pop(rid, None)
+            self._submit_ts.pop(rid, None)
+
+    def adopt_handoff(self, h) -> str:
+        """Adopt one migrated stream (decode role): verify the handoff's
+        per-page checksums, allocate the slot's full footprint through
+        :meth:`PagedKVCache.adopt_pages`, write the prompt KV bytes into
+        fresh device pages, and enter the stream into the decode pool at
+        token index 1 (its first token was sampled on the prefill side).
+
+        Returns the adoption verdict: ``"adopted"`` (stream live),
+        ``"deferred"`` (no free slot / pool pressure — retry next block, as
+        retirements return pages), or ``"degraded"`` (checksum failure: the
+        handoff bytes are poison; the caller re-prefills the stream locally
+        via :meth:`resume` — bit-identical, per the rng contract)."""
+        if self.role != "decode":
+            raise ValueError("adopt_handoff requires role='decode'")
+        req = h.req
+        free = self._free_slots()
+        if not free:
+            return "deferred"
+        if not self._pool_can_admit(req.prompt.size, req.max_new_tokens):
+            self._note_pool_pressure([req])
+            return "deferred"
+        if not h.verify():
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "migrate:corrupt", (self.lane, "migrate"),
+                    block=self.blocks, args={"rid": req.request_id})
+            return "degraded"
+        slot = free[0]
+        pkv = self.session.paged
+        t0 = time.perf_counter()
+        try:
+            pages = pkv.adopt_pages(
+                slot, req.prompt.tolist(), h.payloads,
+                self._write_pages_bytes,
+                req.prompt.size + req.max_new_tokens + self.block_steps)
+        except PagePoolExhausted:
+            self.stats["deferred_admissions"] += 1
+            self._note_pool_pressure([req])
+            return "deferred"
+        # install the device-side slot state between blocks: the block
+        # table rows (host-authoritative) and THIS slot's cache_index only
+        self.session.cache = _set_block_tables(self.session.cache,
+                                               pkv.tables)
+        self.session.cache = _set_cache_index_rows(
+            self.session.cache, [slot], [req.prompt.size])
+        rid = req.request_id
+        self._next_id = max(self._next_id, rid + 1)
+        self.slots[slot] = req
+        self._out[rid] = [int(h.first_token)]
+        self._out_ts[rid] = [h.first_ts]
+        self._last_tok_ts[rid] = h.first_ts
+        self._lengths[slot] = req.prompt.size
+        self.session.lengths[slot] = req.prompt.size
+        self.session.active[slot] = True
+        self._active[slot] = True
+        self._done[slot] = False
+        self._eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
+        self._temp[slot] = req.temperature
+        self._greedy[slot] = req.greedy
+        self._tok[slot] = int(h.first_token)
+        self._slot_keys = self._slot_keys.at[slot].set(self._req_key(rid))
+        self._gen_counts[slot] = 1
+        self._adapter_idx[slot] = 0
+        self.stats["handoffs_adopted"] += 1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._m_handoff.observe(dt_ms)
+        if self.tracer.enabled:
+            now = time.perf_counter()
+            self.tracer.instant(
+                "migrate_adopt", ("req", rid), block=self.blocks, ts=now,
+                args={"slot": int(slot), "pages": len(h.payloads),
+                      "ms": round(dt_ms, 3)})
+            self.tracer.instant(
+                "migrate:recv", (self.lane, "migrate"), block=self.blocks,
+                args={"rid": rid, "pages": len(h.payloads),
+                      "total_pages": len(pages)})
+        return "adopted"
+
     # --- router hooks: resume, drain extraction --------------------------
     # The Router's failover/drain machinery moves whole requests between
     # replicas. Nothing here invents new recovery mechanics — it re-exposes
@@ -1755,6 +1987,10 @@ class ServeEngine:
         uninterrupted run, per the per-request rng contract. The Router's
         failover path (replica died mid-stream) and any external recovery
         record land here."""
+        if self.role == "prefill":
+            raise ValueError(
+                "a prefill worker cannot resume decode streams — route "
+                "replays to a decode worker (DisaggRouter does)")
         self._next_id = max(self._next_id, req.request_id + 1)
         req.start_block = None
         req.first_token_block = None
@@ -2241,6 +2477,7 @@ class ServeEngine:
             })
         out = {
             "engine": self.lane,
+            "role": self.role,
             "blocks": int(self.blocks),
             "queue_depth": len(self.queue),
             "arrived_depth": sum(1 for r in self.queue

@@ -42,6 +42,16 @@ in the same order, so "replay the storm" is a one-line reproducer:
   registry). Either way the request is only ever served under its OWN,
   intact adapter: an adapter fault is a latency event, never a silent
   wrong-adapter token — which the multi-LoRA chaos tests assert.
+* **migrate** (``FaultInjector.on_migrate``) — per prefill→decode KV-page
+  handoff (prefill/decode disaggregation, ``inference/disagg.py``), the
+  transfer may FAIL outright (``migrate_fail_prob`` — the handoff buffer is
+  lost in flight) or its host bytes may be physically garbled first
+  (``migrate_corrupt_prob`` — the per-page crc32 computed at send catches
+  it on adopt). Either way the decode worker degrades to a LOCAL re-prefill
+  of the stream (prompt + the first token the prefill side already
+  sampled), which the per-request rng contract keeps bit-identical: a
+  migration fault is a latency event, never a wrong token — which the
+  disaggregation chaos tests assert.
 * **tier** (``FaultInjector.on_tier_restore``) — per host-tier page read,
   the restore may FAIL outright (``tier_restore_fail_prob`` — an IO error:
   the entry is dropped, the admission re-prefills the suffix) or the tier
@@ -94,12 +104,15 @@ class FaultPlan:
     tier_corrupt_prob: float = 0.0
     adapter_load_fail_prob: float = 0.0
     adapter_corrupt_prob: float = 0.0
+    migrate_fail_prob: float = 0.0
+    migrate_corrupt_prob: float = 0.0
 
     def __post_init__(self):
         for name in ("pool_exhaust_prob", "dispatch_fail_prob",
                      "corrupt_page_prob", "replica_crash_prob",
                      "tier_restore_fail_prob", "tier_corrupt_prob",
-                     "adapter_load_fail_prob", "adapter_corrupt_prob"):
+                     "adapter_load_fail_prob", "adapter_corrupt_prob",
+                     "migrate_fail_prob", "migrate_corrupt_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -111,6 +124,10 @@ class FaultPlan:
             raise ValueError(
                 "adapter_load_fail_prob + adapter_corrupt_prob must be <= 1 "
                 "(one verdict per acquire)")
+        if self.migrate_fail_prob + self.migrate_corrupt_prob > 1.0:
+            raise ValueError(
+                "migrate_fail_prob + migrate_corrupt_prob must be <= 1 "
+                "(one verdict per handoff)")
         if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
             raise ValueError("storm lengths must be >= 1")
         if self.max_replica_crashes < 0:
@@ -146,7 +163,7 @@ class FaultInjector:
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
             for seam in ("alloc", "dispatch", "corrupt", "replica", "tier",
-                         "adapter")
+                         "adapter", "migrate")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
@@ -154,7 +171,8 @@ class FaultInjector:
         self.stats = {"alloc_faults": 0, "dispatch_faults": 0,
                       "pages_corrupted": 0, "replica_crashes": 0,
                       "tier_restore_faults": 0, "tier_corruptions": 0,
-                      "adapter_load_faults": 0, "adapter_corruptions": 0}
+                      "adapter_load_faults": 0, "adapter_corruptions": 0,
+                      "migrate_faults": 0, "migrate_corruptions": 0}
 
     # --- allocator seam --------------------------------------------------
 
@@ -233,6 +251,30 @@ class FaultInjector:
             return "fail"
         if u < frp + tcp:
             self.stats["tier_corruptions"] += 1
+            return "corrupt"
+        return None
+
+    # --- migrate seam ----------------------------------------------------
+
+    def on_migrate(self) -> Optional[str]:
+        """Called by the disaggregation router per prefill→decode KV-page
+        handoff delivery: one draw decides the verdict — ``'fail'`` (the
+        transfer is lost in flight: the decode side re-prefills the stream
+        locally), ``'corrupt'`` (the handoff's host bytes are garbled; the
+        per-page crc32 sealed at send catches it on adopt and the path
+        degrades to the same local re-prefill), or None (clean transfer).
+        One draw per delivery keeps the seam's schedule independent of
+        which verdict fired — the tier/adapter seams' discipline."""
+        mfp = self.plan.migrate_fail_prob
+        mcp = self.plan.migrate_corrupt_prob
+        if not (mfp or mcp):
+            return None
+        u = self._rs["migrate"].random_sample()
+        if u < mfp:
+            self.stats["migrate_faults"] += 1
+            return "fail"
+        if u < mfp + mcp:
+            self.stats["migrate_corruptions"] += 1
             return "corrupt"
         return None
 

@@ -586,7 +586,10 @@ class PagedKVCache:
                       # host-tier surface (zeros with the tier disabled)
                       "tier_spilled_pages": 0, "tier_restored_pages": 0,
                       "tier_hits": 0, "tier_restore_failures": 0,
-                      "tier_repaired_pages": 0}
+                      "tier_repaired_pages": 0,
+                      # prefill/decode disaggregation: pages whose K/V bytes
+                      # arrived through a migration handoff (adopt_pages)
+                      "adopted_pages": 0}
         # host-memory tier (enable_tier): spilled cold prefix pages +
         # device read/write callbacks into the session's page pools
         self.tier: Optional[HostPageTier] = None
@@ -873,6 +876,55 @@ class PagedKVCache:
         if pages:
             self.allocator.release(pages)
         self.tables[slot] = self.scratch[slot]
+
+    def adopt_pages(self, slot: int, tokens: Sequence[int],
+                    payloads: Sequence[Dict[str, np.ndarray]], write_pages,
+                    reserve_total: int) -> List[int]:
+        """Adopt a migrated prompt's KV pages (prefill/decode
+        disaggregation, ``inference/disagg.py``): allocate the slot's FULL
+        footprint (prompt + decode reserve, reclaim-first like every other
+        admission), write the handoff's host bytes into the prompt-covering
+        pages through ``write_pages`` (the engine's BATCHED page-IO
+        closure: one functional update per K/V leaf for the whole page
+        list — the per-page PR 8 transport would copy the pool once per
+        page), install the slot's block
+        table, and register the prompt's fully-covered pages in the prefix
+        index so later admissions on this worker prefix-hit the adopted
+        path. The decode-reserve pages hold stale bytes until decode writes
+        them — behind the position mask, exactly like a fresh insert's
+        unwritten pages. Raises :class:`PagePoolExhausted` with NOTHING
+        allocated (the caller defers and retries as streams retire)."""
+        ps = self.page_size
+        plen = len(tokens)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        n_copy = -(-plen // ps)
+        if len(payloads) != n_copy:
+            raise ValueError(
+                f"{len(payloads)} page payloads for {n_copy} prompt pages")
+        total = min(max(int(reserve_total), plen), self.max_seq_len)
+        n_pages = -(-total // ps)
+        pages = self._alloc_with_reclaim(n_pages)
+        if pages is None:
+            self._note_exhausted(n_pages)
+            raise PagePoolExhausted(
+                f"adoption needs {n_pages} pages, "
+                f"{self.allocator.available()} free")
+        write_pages([int(p) for p in pages[:n_copy]], list(payloads))
+        self.release(slot)
+        table = np.full((self.pages_per_slot,), self.scratch[slot], np.int32)
+        table[:n_pages] = pages
+        self.tables[slot] = table
+        self._slot_pages[slot] = [int(p) for p in pages]
+        if self.prefix is not None:
+            n_full = plen // ps
+            if n_full:
+                self.prefix.register(list(tokens)[: n_full * ps],
+                                     [int(p) for p in pages[:n_full]])
+        self.stats["pages_in_use_peak"] = max(
+            self.stats["pages_in_use_peak"], self.allocator.in_use())
+        self.stats["adopted_pages"] += n_copy
+        return [int(p) for p in pages]
 
     # --- chunked-prefill lifecycle (begin/extend/finish/abort) -----------
     # The one-shot plan/commit pair above allocates a request's WHOLE page

@@ -226,18 +226,20 @@ class Router:
         # the fleet: one lm (shared compiled programs), N sessions. All
         # replicas take the SAME rng base — with router-assigned globally-
         # unique ids that makes streams replica-independent by construction.
-        self.engines: List[ServeEngine] = [
-            ServeEngine(lm, rng=self.rng, name=f"replica{i}",
-                        tracer=self.tracer, faults=self._injector,
-                        **engine_kw)
-            for i in range(num_replicas)
-        ]
+        self.engines: List[ServeEngine] = self._build_engines(
+            lm, num_replicas, engine_kw)
         self.crash_at = [(int(b), int(i)) for b, i in crash_at]
         for _b, i in self.crash_at:
             if not 0 <= i < num_replicas:
                 raise ValueError(f"crash_at names unknown replica {i}")
         n = num_replicas
         self.blocks = 0
+        # per-replica per-block wall seconds (index == router block; skipped
+        # replicas record 0.0): the per-WORKER clock the disaggregation
+        # report reads decode-side latency off (a dedicated decode host
+        # never pays a co-scheduled prefill's wall time — this harness runs
+        # everything in one thread, so the split must be measured per engine)
+        self._eng_block_wall: List[List[float]] = [[] for _ in range(n)]
         self._next_id = 0
         self._vtime = 0.0
         self._tenants: Dict[str, _Tenant] = {}
@@ -269,6 +271,17 @@ class Router:
             "router_pending_depth", help="arrived router backlog")
         self._m_placements = self.metrics.counter(
             "router_placements_total", help="requests placed on replicas")
+
+    def _build_engines(self, lm, num_replicas: int,
+                       engine_kw: dict) -> List[ServeEngine]:
+        """Construct the replica fleet — the seam :class:`DisaggRouter`
+        overrides to assign per-replica roles."""
+        return [
+            ServeEngine(lm, rng=self.rng, name=f"replica{i}",
+                        tracer=self.tracer, faults=self._injector,
+                        **engine_kw)
+            for i in range(num_replicas)
+        ]
 
     # --- tenants / fairness ----------------------------------------------
 
@@ -492,11 +505,17 @@ class Router:
                  if eng.paged and eng.session.paged is not None else 0)
         return (adapter_miss, est_ttft, backlog, -free, pages, i)
 
+    def _viable_replicas(self, e: _Entry) -> List[int]:
+        """Live replicas that can take this entry right now — the seam
+        :class:`DisaggRouter` overrides with role filtering (fresh work →
+        prefill workers, mid-stream replays → decode workers)."""
+        return [i for i in self._live_replicas()
+                if self._can_take(i, e.req)]
+
     def _pick_replica(self, e: _Entry) -> Tuple[Optional[int], int]:
         """Choose a replica for one entry; returns (replica, prefix_hit
         tokens) — (None, 0) when nobody can take it this block."""
-        viable = [i for i in self._live_replicas()
-                  if self._can_take(i, e.req)]
+        viable = self._viable_replicas(e)
         if not viable:
             return None, 0
         if self.placement == "round_robin":
@@ -786,6 +805,10 @@ class Router:
                 if rec is not None:
                     rec.delivered = list(toks)
 
+    def _pump_handoffs(self) -> None:
+        """Prefill→decode handoff choreography — a no-op here; the
+        :class:`DisaggRouter` (inference/disagg.py) overrides it."""
+
     def _observe_block(self) -> None:
         depth = sum(1 for e in self.pending if self._arrived(e))
         self._m_pending.set(depth)
@@ -806,12 +829,16 @@ class Router:
         for i, eng in enumerate(self.engines):
             if (not self._alive[i] or i in self._dark
                     or i in self._drained):
+                self._eng_block_wall[i].append(0.0)
                 continue
             eng.blocks = self.blocks
+            t0 = time.perf_counter()
             if eng.step_block():
                 progressed = True
+            self._eng_block_wall[i].append(time.perf_counter() - t0)
             self._hb[i] = self.blocks
             self._harvest(i)
+        self._pump_handoffs()
         if (self.snapshot_every_blocks
                 and (self.blocks + 1) % self.snapshot_every_blocks == 0):
             for i in self._live_replicas():
@@ -879,6 +906,9 @@ class Router:
                      else "live" if self._alive[i] else "dead")
             out.append({
                 "replica": i, "state": state,
+                # disaggregation role ("both" on a classic homogeneous
+                # fleet): what kind of work placement may hand this replica
+                "role": getattr(eng, "role", "both"),
                 "last_heartbeat_block": self._hb[i],
                 "queue_depth": len(eng.queue),
                 "active_slots": int(sum(1 for r in eng.slots

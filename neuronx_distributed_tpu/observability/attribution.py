@@ -28,6 +28,12 @@ non-overlapping phase segments:
   the same block, so their prefill phase is 0 blocks wide by construction;
 * ``decode``          — first token to the terminal event, minus any
   recovery interruption;
+* ``migration``       — prefill/decode disaggregation handoff: the span
+  between the prefill worker sealing the request's KV pages
+  (``migrate_send``) and the decode worker adopting them
+  (``migrate_adopt``) — or, when the handoff failed/corrupted, the
+  ``replay_admit`` that resumed the stream after the local re-prefill
+  (the whole degraded path is migration price);
 * ``corrupt_replay``  — a corrupted-page re-prefill (``corrupt_replay`` to
   the ``replay_admit`` that resumed the stream);
 * ``failover_replay`` — a replica crash: the blocks between the last
@@ -54,7 +60,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 PHASES = ("queued", "requeue_backoff", "pool_wait", "adapter_load",
-          "prefill", "decode", "corrupt_replay", "failover_replay")
+          "prefill", "decode", "migration", "corrupt_replay",
+          "failover_replay")
 
 # terminal lifecycle events: the walker closes the open phase here
 _TERMINALS = ("retire", "expire", "cancel", "shed", "reject")
@@ -108,7 +115,9 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
     submit_args: dict = {}
     annotations = {"prefill_chunks": 0, "requeues": 0, "pool_defers": 0,
                    "tier_restored_pages": 0, "replays": 0,
-                   "adapter_defers": 0, "adapter_loads": 0}
+                   "adapter_defers": 0, "adapter_loads": 0,
+                   "handoff_pages": 0, "migrate_degrades": 0}
+    first_token_block = None
 
     def close(upto_block, upto_ts, name=None):
         """Charge [cur, upto_block] to ``name`` (default: the open phase)
@@ -181,14 +190,30 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
         elif name == "first_token":
             close(blk, ts)
             phase = "decode"
+            if first_token_block is None:
+                first_token_block = blk
         elif name == "tok":
             last_tok_block, last_tok_ts = blk, ts
+        elif name == "migrate_send":
+            close(blk, ts)
+            phase = "migration"
+            annotations["handoff_pages"] += int(args.get("pages", 0))
+        elif name == "migrate_adopt":
+            close(blk, ts, "migration")
+            phase = "decode"
+        elif name == "migrate_degrade":
+            annotations["migrate_degrades"] += 1
         elif name == "corrupt_replay":
             close(blk, ts)
             phase = "corrupt_replay"
             annotations["replays"] += 1
         elif name == "replay_admit":
-            if phase == "corrupt_replay":
+            if phase == "migration":
+                # a degraded handoff's local re-prefill resumed the stream:
+                # the whole send→resume gap is the migration price
+                close(blk, ts, "migration")
+                annotations["replays"] += 1
+            elif phase == "corrupt_replay":
                 close(blk, ts, "corrupt_replay")
             else:
                 # crash gap: decode ran until the last delivered token,
@@ -219,6 +244,7 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
         "segments": segments,
         "terminal": terminal,
         "in_flight": terminal is None,
+        "first_token_block": first_token_block,
         "tenant": submit_args.get("tenant", "default"),
         "engine": submit_args.get("engine"),
         "ttft_deadline_block": submit_args.get("ttft_deadline_block"),
@@ -267,12 +293,15 @@ def explain_deadline_miss(tracer, request_id: int) -> dict:
     ttft_dl = att["ttft_deadline_block"]
     full_dl = att["deadline_block"]
     # the binding deadline: first token late (or never sampled) binds the
-    # TTFT budget; otherwise the completion budget
-    first_tok = None
-    for s in att["segments"]:
-        if s["phase"] == "decode":
-            first_tok = s["start_block"]
-            break
+    # TTFT budget; otherwise the completion budget. The explicit
+    # first_token_block beats the first decode segment's start — under
+    # disaggregation the first token lands BEFORE the migration phase.
+    first_tok = att.get("first_token_block")
+    if first_tok is None:
+        for s in att["segments"]:
+            if s["phase"] == "decode":
+                first_tok = s["start_block"]
+                break
     if ttft_dl is not None and (first_tok is None or first_tok > ttft_dl):
         kind, dl = "ttft", int(ttft_dl)
     elif full_dl is not None:

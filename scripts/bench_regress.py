@@ -29,6 +29,13 @@ bench.py pulls in jax), else every common numeric key. Non-headline keys
 are compared too but only reported — device-window timings off the
 headline wobble far more than their headline cousins and must not gate.
 
+Keys present in the CANDIDATE but missing from the BASELINE report as an
+explicit ``new_key`` verdict and NEVER fail the gate: the baseline simply
+predates the feature (e.g. the committed r05 sidecar predates the PR 6–10
+serving keys), which is growth, not regression. The reverse — a gated key
+the candidate DROPPED — stays ``missing`` and fails only under
+``--strict-missing``.
+
 Direction-of-goodness and noise tolerance come from an ordered rule table
 (first match wins): throughput/goodput/speedup/acceptance/MFU keys are
 higher-better at 10%, latency/ms keys lower-better at 15% (device timing
@@ -59,6 +66,12 @@ RULES: List[Tuple[str, str, float]] = [
     (r"serve_tracing_overhead_ratio", "higher", 0.03),
     (r"serve_goodput_2x_vs_1x", "higher", 0.10),
     (r"serve_multilora_vs_merged", "higher", 0.10),
+    # prefill/decode disaggregation (ISSUE 11): decode-clock latencies are
+    # lower-better like every _ms key; named explicitly so the gate set's
+    # intent survives even if the generic timing pattern below shifts
+    (r"serve_itl_p(50|99)_ms_disagg", "lower", 0.15),
+    (r"serve_decode_stall_ms_longprompt_disagg", "lower", 0.15),
+    (r"serve_handoff_adopt_ms.*", "lower", 0.15),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better
@@ -151,7 +164,11 @@ def compare(base: Dict[str, float], cand: Dict[str, float],
     for key in sorted(set(base) | set(cand)):
         in_b, in_c = key in base, key in cand
         if not (in_b and in_c):
-            rows.append({"key": key, "verdict": "missing" if in_b else "added",
+            # a candidate-only key is NEW (the baseline predates it) —
+            # reported, never gated; a baseline-only key is MISSING from
+            # the candidate (gate-relevant under --strict-missing)
+            rows.append({"key": key,
+                         "verdict": "missing" if in_b else "new_key",
                          "gated": key in gated_set})
             continue
         b, c = base[key], cand[key]
@@ -251,7 +268,7 @@ def main(argv=None) -> int:
         "gate_basis": gate_basis,
         "gated_keys": len(gated),
         "compared": sum(1 for r in result["rows"]
-                        if r["verdict"] not in ("missing", "added")),
+                        if r["verdict"] not in ("missing", "new_key")),
         "counts": counts,
         "regressions": [
             {k: r.get(k) for k in

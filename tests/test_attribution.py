@@ -216,6 +216,49 @@ def test_chaos_matrix_attribution_invariant_and_incidents(lm, tmp_path):
     assert "replica_crash" in kinds
 
 
+def test_disagg_migration_phase_invariant(lm):
+    """ISSUE 11 satellite: the ``migration`` phase — the handoff span
+    between prefill-done (``migrate_send``) and decode-adopt
+    (``migrate_adopt``, or the ``replay_admit`` a degraded handoff resumes
+    through) — closes the sum(phases)==e2e invariant on a disaggregated
+    chaos run: a small decode pool defers adoptions (nonzero migration
+    width) while the migrate fault seam degrades others to local
+    re-prefill."""
+    from neuronx_distributed_tpu.inference import DisaggRouter
+
+    router = DisaggRouter(
+        lm, 2, prefill_replicas=1, rng=jax.random.key(42), block_steps=K,
+        trace=True,
+        faults=FaultPlan(seed=5, migrate_fail_prob=0.3,
+                         migrate_corrupt_prob=0.2))
+    rs = np.random.RandomState(3)
+    prefix = rs.randint(1, 127, (8,)).astype(np.int32)
+    for i in range(6):
+        tail = rs.randint(1, 127, (8,)).astype(np.int32)
+        router.submit(np.concatenate([prefix, tail]), 12,
+                      arrival_block=i // 2, tenant=f"t{i % 2}",
+                      sampler=Sampler(temperature=1.1) if i % 3 == 2
+                      else None)
+    router.run(max_blocks=400)
+    assert router.stats["handoffs_sent"] == 6
+    assert router.stats["handoffs_degraded"] >= 1, "seam never fired"
+    atts = _check_invariant(router.tracer)
+    assert len(atts) == 6
+    assert any(a["phases_blocks"].get("migration", 0) > 0
+               for a in atts.values()), "no request paid a migration phase"
+    # degraded handoffs are annotated on the request they hit, and their
+    # whole send→resume gap is charged to migration (never lost)
+    degraded = [a for a in atts.values()
+                if a["annotations"]["migrate_degrades"] > 0]
+    assert degraded
+    assert all(a["phases_blocks"].get("migration", 0) > 0
+               for a in degraded)
+    rep = attribution_report(router.tracer)
+    assert "migration" in rep["phases_blocks"]
+    assert rep["phases_blocks"]["migration"]["total"] == sum(
+        a["phases_blocks"].get("migration", 0) for a in atts.values())
+
+
 def test_attribution_matches_run_trace_queue_accounting(lm):
     """Cross-check against the engine's own completion bookkeeping: the
     attribution's queued+pool_wait blocks equal the Completion's

@@ -63,6 +63,11 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_itl_p99_ms_unchunked": 61.0,
                                       "serve_decode_stall_ms_longprompt": 58.0,
                                       "serve_decode_stall_ms_longprompt_chunked": 9.5,
+                                      "serve_itl_p50_ms_disagg": 5.9,
+                                      "serve_itl_p99_ms_disagg": 6.4,
+                                      "serve_decode_stall_ms_longprompt_disagg": 0.4,
+                                      "serve_itl_p99_ms_disagg_inproc": 11.2,
+                                      "serve_disagg_handoffs": 10,
                                       "serve_goodput_1x": 540.0,
                                       "serve_goodput_2x_overload": 512.0,
                                       "serve_goodput_2x_vs_1x": 0.948,
@@ -141,6 +146,20 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
     assert h["serve_decode_stall_ms_longprompt_chunked"] < \
         h["serve_decode_stall_ms_longprompt"]
+    # disaggregation keys (ISSUE 11): decode ITL with zero prefill sharing
+    # must beat the chunked baseline, and the long-prompt stall EXCESS on
+    # the decode clock is ~0 — chunking bounds interference,
+    # disaggregation removes it. In-process wall + handoff counts stay
+    # sidecar-only (caveat trail, not headline)
+    assert d["serve_itl_p99_ms_disagg"] == h["serve_itl_p99_ms_disagg"] == 6.4
+    assert h["serve_itl_p99_ms_disagg"] < h["serve_itl_p99_ms"]
+    assert h["serve_decode_stall_ms_longprompt_disagg"] == 0.4
+    assert h["serve_decode_stall_ms_longprompt_disagg"] < 1.0
+    assert h["serve_decode_stall_ms_longprompt_disagg"] < \
+        h["serve_decode_stall_ms_longprompt_chunked"]
+    assert "serve_itl_p99_ms_disagg_inproc" not in h
+    assert "serve_disagg_handoffs" not in h
+    assert d["serve_disagg_handoffs"] == 10
     # host-tier keys (ISSUE 8): a tiered prefix hit must undercut the cold
     # re-prefill, the pool-pressure shed rate must fall with the tier on,
     # and the restore-latency price tag rides the headline next to them
@@ -377,8 +396,67 @@ def test_bench_regress_direction_and_tolerance(tmp_path):
     assert rc == 1
     # a garbage artifact is a usage error (exit 2), not a pass
     (tmp_path / "junk.json").write_text("[]")
+    _assert_junk_exits_2(tmp_path)
+
+
+def _assert_junk_exits_2(tmp_path):
     p = subprocess.run([sys.executable, str(REGRESS),
                         str(tmp_path / "base.json"),
                         str(tmp_path / "junk.json")],
                        capture_output=True, text=True)
     assert p.returncode == 2
+
+
+def test_bench_regress_new_keys_never_gate(tmp_path):
+    """ISSUE 11 satellite: a baseline that PREDATES a feature's headline
+    keys (the committed r05 sidecar predates PRs 6–11's serving keys) must
+    never fail the gate over them — candidate-only keys report as an
+    explicit ``new_key`` verdict, gated or not."""
+    keys = ["serve_itl_p99_ms", "serve_itl_p99_ms_disagg",
+            "serve_decode_stall_ms_longprompt_disagg"]
+    base = {"headline_keys": keys, "serve_itl_p99_ms": 10.0}
+    cand = {"headline_keys": keys, "serve_itl_p99_ms": 9.8,
+            "serve_itl_p99_ms_disagg": 6.4,
+            "serve_decode_stall_ms_longprompt_disagg": 0.4,
+            "serve_disagg_handoffs": 10.0}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    (tmp_path / "cand.json").write_text(json.dumps(cand))
+    rc, summary, err = _regress(tmp_path / "base.json",
+                                tmp_path / "cand.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass" and not summary["regressions"]
+    assert summary["counts"]["new_key"] == 3
+    # even --strict-missing only guards baseline keys the candidate
+    # DROPPED, never keys the baseline predates
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "cand.json", "--strict-missing")
+    assert rc == 0 and not summary["missing_gated"]
+    # and the committed r05 artifact (predates the PR 6-10 serving keys in
+    # HEADLINE_KEYS) passes as a baseline against a modern-shaped candidate
+    rc, summary, err = _regress(REPO / "BENCH_r05.json",
+                                tmp_path / "cand.json")
+    assert rc == 0, err
+    assert summary["counts"].get("new_key", 0) >= 2
+
+
+def test_bench_regress_disagg_direction_rules(tmp_path):
+    """Direction-of-goodness for the disagg keys: a RISING decode-clock
+    p99 or stall beyond tolerance regresses; falling improves."""
+    keys = ["serve_itl_p99_ms_disagg",
+            "serve_decode_stall_ms_longprompt_disagg"]
+    base = {"headline_keys": keys, "serve_itl_p99_ms_disagg": 6.4,
+            "serve_decode_stall_ms_longprompt_disagg": 1.0}
+    worse = {"headline_keys": keys, "serve_itl_p99_ms_disagg": 9.0,
+             "serve_decode_stall_ms_longprompt_disagg": 1.0}
+    better = {"headline_keys": keys, "serve_itl_p99_ms_disagg": 5.0,
+              "serve_decode_stall_ms_longprompt_disagg": 0.2}
+    for name, doc in (("base", base), ("worse", worse), ("better", better)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "worse.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "serve_itl_p99_ms_disagg"
+    assert summary["regressions"][0]["direction"] == "lower"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "better.json")
+    assert rc == 0 and summary["counts"]["improved"] == 2

@@ -274,6 +274,56 @@ def test_inference_runner_serve_replicas_crash_failover_tiny(capsys):
     assert sum(row["requests"] for row in report["per_tenant"].values()) == 6
 
 
+def test_inference_runner_serve_disagg_tiny(capsys):
+    """ISSUE 11 CI gate: runner.py serve --disagg drives the role-split
+    fleet through the CLI — 1 prefill worker + 1 decode worker, every
+    request's KV pages migrating as a checksummed handoff, every stream
+    completing its full budget, the decode-clock latency surface present,
+    and the decode worker's dispatch contract untouched."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--paged", "--page_size", "4",
+                 "--max_batch", "2", "--num_requests", "4",
+                 "--max_new_tokens", "6", "--fused_steps", "3",
+                 "--disagg", "--replicas", "2", "--prefill_replicas", "1",
+                 "--mean_interarrival", "2.0"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["disagg"] is True
+    assert report["prefill_replicas"] == 1 and report["decode_replicas"] == 1
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["handoffs_sent"] == report["handoffs_adopted"] == 4
+    assert report["handoffs_degraded"] == 0
+    assert report["handoff_pages"] >= 4
+    assert report["itl_p99_ms_decode_clock"] is not None
+    roles = {s["replica"]: s["role"] for s in report["replica_states"]}
+    assert roles == {0: "prefill", 1: "decode"}
+
+
+@pytest.mark.slow  # interference-trace comparison; tier-1 runs -m 'not slow'
+def test_inference_runner_serve_disagg_vs_chunked_interference(capsys):
+    """ISSUE 11 acceptance evidence at tiny scale: the same heavy-tailed
+    long-prompt trace served chunked (single engine) vs disaggregated —
+    the disagg run's decode-clock p99 ITL must undercut the chunked run's
+    wall p99 (the decode worker never pays a prefill), and the long-prompt
+    stall excess stays near zero."""
+    import runner
+
+    common = ["serve", "--tiny", "--paged", "--page_size", "4",
+              "--max_batch", "2", "--num_requests", "8",
+              "--max_new_tokens", "8", "--fused_steps", "3",
+              "--prefill_chunk_tokens", "8",
+              "--long_prompt_frac", "0.25", "--long_prompt_len", "24"]
+    runner.main(common)
+    chunked = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    runner.main(common + ["--disagg", "--replicas", "2",
+                          "--prefill_replicas", "1"])
+    disagg = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert disagg["requests_completed"] == chunked["requests_completed"] == 8
+    assert disagg["itl_p99_ms_decode_clock"] < chunked["itl_p99_ms"]
+    assert disagg["decode_stall_excess_ms"] is not None
+
+
 def test_inference_runner_serve_multilora_tiny(capsys):
     """ISSUE 10 CI gate: runner.py serve --adapters drives the multi-LoRA
     pool through the CLI — 3 Zipf-labeled adapters share ONE base model

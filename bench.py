@@ -1664,6 +1664,91 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     except Exception as e:  # noqa: BLE001 — router section additive, never fatal
         out["serve_router_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # --- SLO-driven autoscaling (ISSUE 12 tentpole evidence): the SAME
+    # diurnal trace (streamed — synthetic_trace_stream, no materialized
+    # request list) served by a FIXED max-provisioned N=4 fleet vs an
+    # elastic fleet starting at 1 replica under the Autoscaler policy
+    # (scale-up on weighted backlog, scale-down drains + parks, warm
+    # unparks from the parked snapshot). Streams are bit-identical by the
+    # per-request rng contract, so the headline is capacity honesty:
+    # goodput PER PROVISIONED REPLICA-BLOCK, autoscaled over fixed — >= 1.0
+    # means elasticity tracked the diurnal load without giving back
+    # deadline goodput. Both runs live on the virtual block clock, so the
+    # ratio is deterministic (no wall noise); the wall numbers (spawn cost)
+    # ride the sidecar.
+    try:
+        from neuronx_distributed_tpu.inference.autoscale import (
+            Autoscaler, AutoscalePolicy,
+        )
+        from neuronx_distributed_tpu.inference.engine import (
+            synthetic_trace_stream,
+        )
+        from neuronx_distributed_tpu.inference.router import (
+            Router as _ARouter, run_router_trace as _arun,
+        )
+        page_size = 16
+        ppseq = (prompt_len + 256) // page_size
+        lm_as = CausalLM(lcfg, model.params, LlamaForCausalLM,
+                         buckets=(prompt_len,), max_batch=max_batch,
+                         page_size=page_size,
+                         page_pool_pages=max_batch * ppseq + max_batch)
+        lm_as.compile()
+        mnt_a = 24
+        deadline_a = 16.0
+
+        def diurnal_stream():
+            return synthetic_trace_stream(
+                48, 32000, prompt_lens=(prompt_len,), max_new_tokens=mnt_a,
+                mean_interarrival_blocks=0.5, deadline_ms=deadline_a,
+                diurnal=0.85, diurnal_period_blocks=32, seed=11)
+
+        for rows in range(1, max_batch + 1):
+            lm_as._paged_insert_programs(rows, prompt_len)
+        warm_a = ServeEngine(lm_as, block_steps=fused_steps)
+        for item in list(diurnal_stream())[:max_batch]:
+            warm_a.submit(item["prompt"], 2)
+        warm_a.run()
+
+        def ontime_tokens(r):
+            return sum(len(c.tokens) for c in r.completed
+                       if not (c.deadline_missed or c.expired or c.cancelled))
+
+        r_fix = _ARouter(lm_as, 4, block_steps=fused_steps,
+                         rng=jax.random.key(0))
+        _arun(r_fix, diurnal_stream())
+        pol_a = AutoscalePolicy(
+            min_replicas=1, max_replicas=4, backlog_high_blocks=1.0,
+            up_patience_blocks=2, down_utilization=0.4,
+            down_patience_blocks=6, cooldown_blocks=6)
+        r_auto = _ARouter(lm_as, 1, block_steps=fused_steps,
+                          rng=jax.random.key(0), autoscaler=Autoscaler(pol_a))
+        rep_auto = _arun(r_auto, diurnal_stream())
+        fix_g = ontime_tokens(r_fix) / max(r_fix.stats["replica_blocks"], 1)
+        auto_g = ontime_tokens(r_auto) / max(r_auto.stats["replica_blocks"], 1)
+        out["serve_goodput_autoscale_vs_fixed"] = round(auto_g / fix_g, 3)
+        a_sec = rep_auto["autoscale"]
+        out["serve_scaleup_time_to_ready_blocks"] = \
+            a_sec["time_to_ready_blocks_mean"]
+        out["serve_autoscale_scale_ups"] = a_sec["scale_ups"]
+        out["serve_autoscale_scale_downs"] = a_sec["scale_downs"]
+        out["serve_autoscale_warm_spawns"] = a_sec["warm_spawns"]
+        out["serve_autoscale_replica_blocks"] = r_auto.stats["replica_blocks"]
+        out["serve_fixed_replica_blocks"] = r_fix.stats["replica_blocks"]
+        out["serve_scaleup_spawn_ms"] = a_sec["last_spawn_ms"]
+        out["serve_autoscale_basis"] = (
+            f"48-request streamed diurnal trace (amp 0.85, period 32 "
+            f"blocks, 0.5 blocks mean interarrival), {prompt_len}-tok "
+            f"prompts, {mnt_a} new tokens, deadline {deadline_a:g} blocks; "
+            f"elastic 1..4 replicas (backlog>1 block/replica for 2 blocks "
+            f"scales up, util<0.4 for 6 blocks drains+parks, cooldown 6) "
+            f"vs fixed N=4; ratio = on-deadline tokens per replica-block, "
+            f"autoscaled/fixed (virtual clock — deterministic); "
+            f"time-to-ready = blocks from scale decision to the new "
+            f"replica's first placement")
+        del lm_as, warm_a, r_fix, r_auto
+    except Exception as e:  # noqa: BLE001 — autoscale section additive, never fatal
+        out["serve_autoscale_error"] = f"{type(e).__name__}: {e}"[:120]
+
     # --- multi-LoRA serving (ISSUE 10 tentpole evidence). Two claims:
     # (a) a mixed 8-adapter Zipf trace served through the pooled low-rank
     #     path (per-row gathered y += s·(x@A)@B, ONE compiled program for
@@ -1814,11 +1899,13 @@ HEADLINE_KEYS = (
     "serve_agg_goodput_2x_n4", "serve_agg_goodput_2x_n4_rr",
     "serve_tenant_p99_fairness_ratio", "serve_failover_replay_ms",
     "serve_drain_ms",
+    "serve_goodput_autoscale_vs_fixed", "serve_scaleup_time_to_ready_blocks",
     "serve_tokens_per_sec_multilora", "serve_multilora_vs_merged",
     "adapter_switch_overhead_ms",
     "ttft_error", "spec_bench_error", "serve_bench_error", "serve_paged_error",
     "serve_chunked_error", "serve_overload_error", "serve_router_error",
     "serve_tier_error", "serve_multilora_error", "serve_disagg_error",
+    "serve_autoscale_error",
 )
 
 

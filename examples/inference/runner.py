@@ -455,11 +455,34 @@ def cmd_serve(args) -> None:
     # trace and in serve_slo_alerts_total. The completion objective rides
     # along whenever any SLO flag is set.
     slos = None
-    if args.slo_ttft_ms or args.slo_itl_ms:
+    if args.slo_ttft_ms or args.slo_itl_ms or args.scale_slo_ms:
         from neuronx_distributed_tpu.observability import default_slos
 
-        slos = default_slos(ttft_ms=args.slo_ttft_ms,
+        # --scale_slo_ms doubles as a TTFT objective: its burn alerts are
+        # what the autoscaler's slo_burn signal latches on
+        slos = default_slos(ttft_ms=args.slo_ttft_ms or args.scale_slo_ms,
                             itl_ms=args.slo_itl_ms, target=args.slo_target)
+    # SLO-driven autoscaling (inference/autoscale.py): the policy runs in
+    # the router's block loop and mutates fleet membership live — scale-up
+    # spawns replicas (warm from parked snapshots), scale-down drains and
+    # parks them; on --disagg each role pool scales independently under
+    # the same policy knobs (min/max apply per pool)
+    autoscaler = None
+    if args.autoscale:
+        from neuronx_distributed_tpu.inference.autoscale import (
+            Autoscaler, AutoscalePolicy,
+        )
+
+        max_reps = args.max_replicas or max(args.replicas,
+                                            args.min_replicas + 1)
+        autoscaler = Autoscaler(AutoscalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=max_reps,
+            backlog_high_blocks=args.scale_up_backlog,
+            up_patience_blocks=args.scale_patience_blocks,
+            down_utilization=args.scale_down_util,
+            down_patience_blocks=args.scale_down_idle_blocks,
+            cooldown_blocks=args.scale_cooldown_blocks))
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
                   prefill_chunk_tokens=args.prefill_chunk_tokens,
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
@@ -530,9 +553,13 @@ def cmd_serve(args) -> None:
         tenant_skew=args.tenant_skew,
         adapters=args.adapters,
         adapter_skew=args.adapter_skew,
+        diurnal=args.diurnal,
+        diurnal_period_blocks=args.diurnal_period_blocks,
+        burst_every=args.burst_every,
+        burst_mult=args.burst_mult,
         seed=args.seed,
     )
-    if args.replicas > 1:
+    if args.replicas > 1 or args.autoscale:
         # multi-replica front door: N ServeEngine replicas (one shared lm,
         # N sessions) behind the Router — prefix-affinity placement,
         # per-tenant WFQ, heartbeat failover, graceful drain.
@@ -567,11 +594,15 @@ def cmd_serve(args) -> None:
             router = DisaggRouter(
                 lm, args.replicas, prefill_replicas=args.prefill_replicas,
                 rng=jax.random.key(args.seed), crash_at=crash_at,
+                autoscaler=autoscaler,
                 faults=resolve_fault_plan(args.fault_plan), **eng_kw)
             report = run_disagg_trace(router, trace)
         else:
-            router = Router(lm, args.replicas, rng=jax.random.key(args.seed),
-                            crash_at=crash_at,
+            # an autoscaled fleet STARTS at the policy floor and grows on
+            # demand; a fixed fleet starts (and stays) at --replicas
+            start_n = args.min_replicas if args.autoscale else args.replicas
+            router = Router(lm, start_n, rng=jax.random.key(args.seed),
+                            crash_at=crash_at, autoscaler=autoscaler,
                             faults=resolve_fault_plan(args.fault_plan),
                             **eng_kw)
             if adapter_reg:
@@ -851,6 +882,56 @@ def main(argv=None) -> None:
                        help="serve --disagg: how many of the N replicas "
                             "are dedicated prefill workers (the rest run "
                             "the fused decode scan + page adoption)")
+        p.add_argument("--autoscale", action="store_true",
+                       help="serve: run the SLO-driven autoscaler in the "
+                            "router block loop — the fleet starts at "
+                            "--min_replicas and scales between the min/max "
+                            "bounds (scale-up on weighted backlog / pool "
+                            "pressure / SLO burn, scale-down drains + "
+                            "parks the least-loaded replica; warm re-spawn "
+                            "from parked snapshots). With --disagg the "
+                            "prefill and decode pools scale independently "
+                            "(bounds apply per pool)")
+        p.add_argument("--min_replicas", type=int, default=1,
+                       help="serve --autoscale: fleet floor (crashes below "
+                            "it are re-spawned immediately)")
+        p.add_argument("--max_replicas", type=int, default=0,
+                       help="serve --autoscale: fleet ceiling (0 = "
+                            "max(--replicas, --min_replicas + 1))")
+        p.add_argument("--scale_slo_ms", type=float, default=None,
+                       help="serve --autoscale: arm a TTFT SLO objective "
+                            "at this many wall ms on every replica — its "
+                            "multi-window burn alerts become the "
+                            "autoscaler's slo_burn scale-up signal")
+        p.add_argument("--scale_up_backlog", type=float, default=1.0,
+                       help="serve --autoscale: weighted router backlog "
+                            "(in blocks of work per live replica) above "
+                            "which the fleet scales up")
+        p.add_argument("--scale_patience_blocks", type=int, default=2,
+                       help="serve --autoscale: consecutive over-threshold "
+                            "blocks before a scale-up fires")
+        p.add_argument("--scale_down_util", type=float, default=0.4,
+                       help="serve --autoscale: fleet utilization below "
+                            "which the pool is oversized")
+        p.add_argument("--scale_down_idle_blocks", type=int, default=8,
+                       help="serve --autoscale: consecutive low-util "
+                            "blocks before a scale-down drains a replica")
+        p.add_argument("--scale_cooldown_blocks", type=int, default=8,
+                       help="serve --autoscale: minimum blocks between "
+                            "scale events of one pool")
+        p.add_argument("--diurnal", type=float, default=0.0,
+                       help="serve: diurnal arrival-rate amplitude in "
+                            "[0,1) — rate scaled by 1 + a*sin(2*pi*t/"
+                            "--diurnal_period_blocks) (the autoscaling "
+                            "workload shape)")
+        p.add_argument("--diurnal_period_blocks", type=int, default=64,
+                       help="serve --diurnal: day length in blocks")
+        p.add_argument("--burst_every", type=int, default=0,
+                       help="serve: every this many blocks, the first "
+                            "quarter of the window arrives --burst_mult x "
+                            "faster (square-wave flash crowds)")
+        p.add_argument("--burst_mult", type=float, default=4.0,
+                       help="serve --burst_every: burst rate multiplier")
         p.add_argument("--tenants", type=int, default=0,
                        help="serve: label trace requests with this many "
                             "tenants, Zipf-skewed (t0 is the heavy hitter); "

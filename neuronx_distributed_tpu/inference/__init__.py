@@ -8,14 +8,20 @@ from neuronx_distributed_tpu.inference.adapters import (  # noqa: F401
     AdapterPool,
     AdapterPoolExhausted,
 )
+from neuronx_distributed_tpu.inference.autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    Autoscaler,
+)
 from neuronx_distributed_tpu.inference.causal_lm import CausalLM, GenerationResult  # noqa: F401
 from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     Completion,
     Rejected,
+    ReplicaLoad,
     Request,
     ServeEngine,
     run_trace,
     synthetic_trace,
+    synthetic_trace_stream,
 )
 from neuronx_distributed_tpu.inference.faults import (  # noqa: F401
     DispatchFailed,

@@ -1,0 +1,338 @@
+"""SLO-driven autoscaling control plane: an elastic replica fleet on the
+virtual block clock (ROADMAP #17 — the "millions of users" story is
+elastic capacity, not a fixed N).
+
+Every primitive already existed: snapshot/restore (PR 5), graceful drain
+with zero-loss migration (PR 7), multiwindow burn-rate SLO alerts (PR 9),
+and prefill/decode roles (PR 11). This module closes the loop: an
+:class:`Autoscaler` policy runs INSIDE the :class:`Router`/
+:class:`DisaggRouter` block loop (``Router(autoscaler=...)``) and mutates
+fleet membership live —
+
+* **scale-up** spawns a replica when an SLO burn rule latches on any live
+  replica (``SLOMonitor.alerting`` — the PR 9 alert, now an actuator, not
+  just a page), when the WEIGHTED router backlog (WFQ cost over tenant
+  weight, the same currency placement fairness runs on) exceeds the live
+  fleet's per-block service rate for ``up_patience_blocks`` consecutive
+  blocks, or when every live replica's page pool is saturated. The spawn
+  is WARM when a parked snapshot of the right role exists
+  (``ServeEngine.from_snapshot`` on the shared lm — shared compiled
+  programs, so a spawn costs a session + replays, never a compile) and
+  COLD otherwise; registered LoRA adapters are re-registered either way.
+* **scale-down** picks the least-loaded live replica once fleet
+  utilization (active slots + engine backlogs + the router's arrived
+  backlog, over fleet slot capacity) stays under ``down_utilization`` for
+  ``down_patience_blocks`` blocks, and retires it through the PR 7
+  ``drain`` machinery: placement stops, queued/mid-prefill work migrates
+  with its fairness tags and adapter pins, decoding streams finish in
+  place — zero tokens lost — and the final snapshot PARKS in
+  ``Router.snapshots`` as the next scale-up's warm image.
+* on a :class:`DisaggRouter` the prefill and decode pools scale
+  INDEPENDENTLY, each off its own signals (per-role policies via
+  ``per_role=``): the prefill pool sees the fresh-prompt backlog, the
+  decode pool sees mid-stream replays plus handoffs the decode side could
+  not adopt (the pool-full deferral — exactly the "handoff gap" the PR 11
+  report surfaces) — the folded ROADMAP #13 remainder.
+
+Determinism: every stock signal is a VIRTUAL-BLOCK-CLOCK quantity
+(weighted backlog, slot/pool occupancy, error-ratio SLO burn over
+block-deterministic counters), so a (trace, policy, seed) triple replays
+to the identical scale-event sequence — and the per-request rng contract
+(token t of request r draws ``fold_in(fold_in(base, r), t)`` wherever it
+runs) makes the STREAMS placement-independent by design, so the oracle is
+sharp: an autoscaled fleet's token streams are bit-identical to a fixed-N
+fleet's, greedy or sampled, across scale-ups, parks, warm unparks and
+replica crashes (tests/test_autoscale.py pins the matrix). The one
+carve-out: wall-latency SLO objectives (TTFT/ITL ms histograms) observe
+real time — alerts from those replay only as far as wall timings do; the
+completion (error-ratio) objective and the backlog/pool signals carry the
+replay guarantee.
+
+Observability: scale decisions land on the shared tracer's
+``("router", "scale")`` lane (``scale_up``/``scale_down``/``scale_parked``
+instants + a ``replicas_active`` counter track), in the
+``serve_replicas_active`` gauge and ``router_scale_events_total``
+counters, and — when a flight recorder is armed — as bounded ``scale``
+incident bundles (capacity changes are exactly the events a post-incident
+review needs pinned next to the burn alerts that caused them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = ["AutoscalePolicy", "Autoscaler"]
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Knobs of one role pool's elasticity (the classic Router is one pool
+    of role ``"both"``; a DisaggRouter runs a ``"prefill"`` and a
+    ``"decode"`` pool, each with its own policy via
+    ``Autoscaler(per_role=...)``).
+
+    Thresholds are dimensionless on the virtual clock:
+    ``backlog_high_blocks`` is weighted-backlog-tokens over the live
+    pool's per-block service rate (1.0 = one full block of undispatched
+    work per replica already queued at the router), ``pool_high`` a page
+    occupancy fraction that must hold on EVERY live replica (one cold pool
+    means capacity exists), ``down_utilization`` the busy fraction of
+    fleet slot capacity under which the pool is oversized. Patience
+    counts consecutive blocks (one bursty block must not spawn a
+    replica); ``cooldown_blocks`` separates consecutive scale events of
+    one pool so a spawn's effect is observed before the next decision —
+    ``min_replicas`` enforcement (a crashed pool refilled to its floor)
+    deliberately ignores the cooldown."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    backlog_high_blocks: float = 1.0
+    pool_high: float = 0.95
+    slo_scale_up: bool = True
+    up_patience_blocks: int = 2
+    down_utilization: float = 0.4
+    down_patience_blocks: int = 8
+    cooldown_blocks: int = 8
+    warm_from_park: bool = True
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}")
+        if self.backlog_high_blocks <= 0:
+            raise ValueError(
+                f"backlog_high_blocks must be > 0, got "
+                f"{self.backlog_high_blocks}")
+        if not 0.0 < self.pool_high <= 1.0:
+            raise ValueError(f"pool_high must be in (0, 1], got "
+                             f"{self.pool_high}")
+        if not 0.0 <= self.down_utilization < 1.0:
+            raise ValueError(
+                f"down_utilization must be in [0, 1), got "
+                f"{self.down_utilization}")
+        if self.up_patience_blocks < 1 or self.down_patience_blocks < 1:
+            raise ValueError("patience blocks must be >= 1")
+        if self.cooldown_blocks < 0:
+            raise ValueError(
+                f"cooldown_blocks must be >= 0, got {self.cooldown_blocks}")
+
+
+@dataclasses.dataclass
+class _Signals:
+    """One pool's deterministic per-block reading (all block-clock
+    quantities — see the module docstring's determinism statement)."""
+
+    live: List[int]
+    backlog_blocks: float
+    pool_pressure: Optional[float]   # min live-replica page occupancy
+    slo_alerting: bool
+    utilization: float
+    up_reason: Optional[str] = None
+
+
+class Autoscaler:
+    """The policy object a Router hosts (``Router(autoscaler=...)``); one
+    instance per router — it keeps per-pool patience/cooldown state and
+    the deterministic ``scale_events`` log the replay tests compare."""
+
+    def __init__(self, policy: Optional[AutoscalePolicy] = None,
+                 per_role: Optional[Dict[str, AutoscalePolicy]] = None):
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.per_role = dict(per_role or {})
+        # the deterministic event log: (block, action, role, replica,
+        # reason, warm) — NO wall quantities (those ride the router's
+        # stats/metrics) so replay comparisons are exact
+        self.scale_events: List[dict] = []
+        self._over: Dict[str, int] = {}
+        self._idle: Dict[str, int] = {}
+        self._last_event: Dict[str, int] = {}
+        self._parked_seen: set = set()
+        # up-event index -> blocks-to-first-placement, resolved eagerly
+        # each block (a later re-spawn of the same index resets the
+        # router's marker, so resolution cannot be deferred to run end)
+        self._ttr: Dict[int, int] = {}
+
+    def policy_for(self, role: str) -> AutoscalePolicy:
+        return self.per_role.get(role, self.policy)
+
+    # --- signals ----------------------------------------------------------
+
+    @staticmethod
+    def _entry_role(e) -> str:
+        """Which pool a pending router entry loads: mid-stream replays are
+        decode work, everything else (fresh admissions and zero-token
+        replays) is prefill work — mirrors DisaggRouter._viable_replicas.
+        On a classic fleet every entry matches the single "both" pool."""
+        return "decode" if (e.replay and e.generated) else "prefill"
+
+    def _signals(self, router, role: str, live: List[int]) -> _Signals:
+        pol = self.policy_for(role)
+        loads = [router.engines[i].load_summary() for i in live]
+        eng0 = router.engines[live[0]] if live else router.engines[0]
+        slots = eng0.lm.max_batch
+        rate = slots * eng0.block_steps          # tokens per replica-block
+        arrived = [e for e in router.pending if router._arrived(e)
+                   and (role == "both" or self._entry_role(e) == role)]
+        w_tokens = sum(router._cost(e.req)
+                       / router._tenant(e.req.tenant).weight
+                       for e in arrived)
+        extra_slots = 0
+        if role == "decode":
+            # handoffs the decode pool could not adopt are decode backlog
+            # the router queue never sees (the PR 11 deferral path)
+            handoffs = list(getattr(router, "_handoffs", ()))
+            w_tokens += sum(h.req.max_new_tokens for h in handoffs)
+            extra_slots = len(handoffs)
+        n = max(len(live), 1)
+        backlog_blocks = w_tokens / float(n * rate)
+        occ = [l.pages_in_use / max(l.pages_in_use + l.pages_free, 1)
+               for l in loads
+               if l.pages_in_use is not None and l.pages_free is not None]
+        pool_pressure = min(occ) if occ and len(occ) == len(loads) else None
+        slo = any(l.slo_alerting for l in loads)
+        # busy = occupied slots + work WAITING for one (queued + replays;
+        # mid-prefill slots are already inside active_slots — counting
+        # them again would read a prefill-heavy fleet as >100% busy)
+        busy = (sum(l.active_slots + l.queue_depth + l.replays
+                    for l in loads)
+                + len(arrived) + extra_slots)
+        utilization = busy / float(n * slots)
+        up = None
+        if slo and pol.slo_scale_up:
+            up = "slo_burn"
+        elif backlog_blocks > pol.backlog_high_blocks:
+            up = "queue_depth"
+        elif pool_pressure is not None and pool_pressure >= pol.pool_high:
+            up = "pool_pressure"
+        return _Signals(live=live, backlog_blocks=backlog_blocks,
+                        pool_pressure=pool_pressure, slo_alerting=slo,
+                        utilization=utilization, up_reason=up)
+
+    # --- the per-block decision -------------------------------------------
+
+    def observe_block(self, router) -> None:
+        """One policy evaluation per router block; runs BEFORE placement
+        so freshly spawned capacity takes this block's arrivals. The
+        router calls this — nothing here is wall-clock."""
+        self._resolve_ttr(router)
+        for i in sorted(router._drained):
+            if i not in self._parked_seen and i in router.snapshots:
+                self._parked_seen.add(i)
+                self._note(router, {
+                    "block": int(router.blocks), "action": "parked",
+                    "role": router.role_of(i), "replica": int(i),
+                    "reason": "drain_complete", "warm": None})
+        for role in router.fleet_roles():
+            self._observe_role(router, role)
+
+    def _observe_role(self, router, role: str) -> None:
+        pol = self.policy_for(role)
+        live = [i for i in router._live_replicas()
+                if router.role_of(i) == role]
+        # floor enforcement first, cooldown-exempt: a crash that dropped
+        # the pool under its minimum is a capacity emergency, not a tuning
+        # decision (this is also what replaces crashed replicas)
+        while len(live) < pol.min_replicas:
+            self._scale_up(router, role, pol, "min_replicas")
+            live = [i for i in router._live_replicas()
+                    if router.role_of(i) == role]
+        sig = self._signals(router, role, live)
+        self._over[role] = self._over.get(role, 0) + 1 if sig.up_reason else 0
+        idle = (sig.up_reason is None
+                and sig.utilization < pol.down_utilization)
+        self._idle[role] = self._idle.get(role, 0) + 1 if idle else 0
+        last = self._last_event.get(role)
+        cooled = last is None or router.blocks - last >= pol.cooldown_blocks
+        draining_role = any(router.role_of(i) == role
+                            for i in router._draining)
+        if (sig.up_reason is not None and cooled
+                and self._over[role] >= pol.up_patience_blocks
+                and len(live) < pol.max_replicas):
+            self._scale_up(router, role, pol, sig.up_reason)
+        elif (cooled and not draining_role
+                and self._idle[role] >= pol.down_patience_blocks
+                and len(live) > pol.min_replicas):
+            loads = {i: router.engines[i].load_summary() for i in live}
+            victim = min(live, key=lambda i: (
+                loads[i].active_slots + loads[i].backlog, -i))
+            self._scale_down(router, role, victim)
+
+    def _scale_up(self, router, role: str, pol: AutoscalePolicy,
+                  reason: str) -> None:
+        i = router.add_replica(role=role, warm=pol.warm_from_park)
+        self._over[role] = 0
+        self._idle[role] = 0
+        self._last_event[role] = int(router.blocks)
+        self._parked_seen.discard(i)
+        self._note(router, {
+            "block": int(router.blocks), "action": "up", "role": role,
+            "replica": int(i), "reason": reason,
+            "warm": bool(router.last_spawn["warm"])})
+
+    def _scale_down(self, router, role: str, victim: int) -> None:
+        router.drain(victim)
+        router.stats["scale_downs"] += 1
+        self._idle[role] = 0
+        self._last_event[role] = int(router.blocks)
+        self._note(router, {
+            "block": int(router.blocks), "action": "down", "role": role,
+            "replica": int(victim), "reason": "idle", "warm": None})
+
+    def _note(self, router, ev: dict) -> None:
+        self.scale_events.append(ev)
+        router.metrics.counter(
+            "router_scale_events_total", help="autoscaler fleet mutations",
+            action=ev["action"], role=ev["role"]).inc()
+        if router.tracer.enabled:
+            router.tracer.instant(
+                f"scale_{ev['action']}" if ev["action"] != "parked"
+                else "scale_parked",
+                ("router", "scale"), block=router.blocks, args=dict(ev))
+        if router.incident is not None and ev["action"] in ("up", "down"):
+            router.incident.trigger(
+                "scale", router.blocks, details=dict(ev),
+                state=router.state_summary())
+
+    # --- reporting --------------------------------------------------------
+
+    def _resolve_ttr(self, router) -> None:
+        for idx, ev in enumerate(self.scale_events):
+            if ev["action"] != "up" or idx in self._ttr:
+                continue
+            fp = router._first_place_block.get(ev["replica"])
+            if fp is not None and fp >= ev["block"]:
+                self._ttr[idx] = int(fp) - int(ev["block"])
+
+    def time_to_ready_blocks(self, router) -> List[int]:
+        """Per scale-up event: blocks from the decision to the new
+        replica's FIRST placement (0 = it took work the same block — the
+        scaler runs ahead of placement); events whose replica never
+        received work before re-parking are omitted. Spawn wall cost is a
+        separate, non-deterministic number
+        (``router.last_spawn['spawn_ms']`` / ``serve_scaleup_spawn_ms``)."""
+        self._resolve_ttr(router)
+        return [self._ttr[i] for i in sorted(self._ttr)]
+
+    def report(self, router) -> dict:
+        """The serve report's ``autoscale`` section."""
+        ttr = self.time_to_ready_blocks(router)
+        return {
+            "scale_events": [dict(ev) for ev in self.scale_events],
+            "scale_ups": sum(1 for ev in self.scale_events
+                             if ev["action"] == "up"),
+            "scale_downs": sum(1 for ev in self.scale_events
+                               if ev["action"] == "down"),
+            "warm_spawns": int(router.stats["warm_spawns"]),
+            "cold_spawns": int(router.stats["cold_spawns"]),
+            "replicas_active": len(router._live_replicas()),
+            "replica_blocks": int(router.stats["replica_blocks"]),
+            "time_to_ready_blocks_mean": (round(sum(ttr) / len(ttr), 2)
+                                          if ttr else None),
+            "time_to_ready_blocks_max": max(ttr) if ttr else None,
+            "last_spawn_ms": router.last_spawn.get("spawn_ms"),
+        }

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -928,7 +928,8 @@ class CausalLM:
     def _insert_paged(self, session: "DecodeSession", slot_ids: np.ndarray,
                       prompt_ids: np.ndarray, lengths: np.ndarray,
                       reserve_tokens,
-                      adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
+                      adapter_slots: Optional[np.ndarray] = None,
+                      ns: Optional[Sequence[Optional[str]]] = None) -> jax.Array:
         """Paged admission: per-row prefix lookup + page allocation (host),
         then ONE suffix-width prefill-and-scatter program. ``reserve_tokens``
         (scalar or per-row) bounds the decode room reserved in pages —
@@ -943,11 +944,15 @@ class CausalLM:
         else:
             totals = lengths.astype(np.int64) + np.broadcast_to(
                 np.asarray(reserve_tokens, np.int64), (rows,))
+        # per-row adapter namespace for the radix walk: prefix KV is
+        # adapter-specific, so reuse is scoped to (tokens, adapter)
+        nss = list(ns) if ns is not None else [None] * rows
         plans = []
         try:
             for i in range(rows):
                 plans.append(pkv.plan(
-                    prompt_ids[i, : lengths[i]].tolist(), int(totals[i])))
+                    prompt_ids[i, : lengths[i]].tolist(), int(totals[i]),
+                    ns=nss[i]))
         except Exception:
             for p in plans:
                 pkv.rollback(p)
@@ -981,7 +986,7 @@ class CausalLM:
         session.cache = cache
         for i in range(rows):
             pkv.commit(int(slot_ids[i]), plans[i],
-                       prompt_ids[i, : lengths[i]].tolist())
+                       prompt_ids[i, : lengths[i]].tolist(), ns=nss[i])
         session.lengths[slot_ids] = lengths
         session.active[slot_ids] = True
         last = jnp.asarray(np.maximum(suffix - 1, 0))
@@ -991,7 +996,8 @@ class CausalLM:
                prompt_ids: np.ndarray, lengths: Optional[np.ndarray] = None,
                pad_token_id: int = 0,
                reserve_tokens: Optional[Any] = None,
-               adapter_slots: Optional[np.ndarray] = None) -> jax.Array:
+               adapter_slots: Optional[np.ndarray] = None,
+               ns: Optional[Sequence[Optional[str]]] = None) -> jax.Array:
         """Prefill ``slot_ids`` with new prompts; every OTHER slot's cache
         rows and lengths are preserved (they may be mid-generation).
 
@@ -1023,7 +1029,7 @@ class CausalLM:
                                  "start_session() (no paged state attached)")
             return self._insert_paged(session, slot_ids, prompt_ids, lengths,
                                       reserve_tokens,
-                                      adapter_slots=adapter_slots)
+                                      adapter_slots=adapter_slots, ns=ns)
         bucket = self._bucket_for(s)
         rows = len(slot_ids)
         prefill, scatter = self._insert_programs(rows, bucket)

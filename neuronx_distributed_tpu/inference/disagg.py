@@ -159,7 +159,12 @@ class DisaggRouter(Router):
                 f"fleet needs at least one worker of each role)")
         if "role" in kw:
             raise ValueError("role is assigned per replica by the router")
-        self.prefill_replicas = int(prefill_replicas)
+        # per-index role table (a LIST, not a count: the autoscaler grows
+        # each pool independently, so roles are no longer index-contiguous
+        # — prefill_replicas becomes the derived count property below)
+        self.roles: List[str] = [
+            "prefill" if i < int(prefill_replicas) else "decode"
+            for i in range(num_replicas)]
         self._handoffs: deque = deque()
         self._decode_home: Dict[int, int] = {}
         super().__init__(lm, num_replicas, **kw)
@@ -171,8 +176,28 @@ class DisaggRouter(Router):
 
     # --- roles ------------------------------------------------------------
 
+    @property
+    def prefill_replicas(self) -> int:
+        return sum(1 for r in self.roles if r == "prefill")
+
     def role_of(self, i: int) -> str:
-        return "prefill" if i < self.prefill_replicas else "decode"
+        return self.roles[i]
+
+    def fleet_roles(self) -> List[str]:
+        # both pools are always scale targets, even while one has no live
+        # member (the min_replicas floor re-spawns it)
+        return ["decode", "prefill"]
+
+    def _note_new_replica(self, i: int, role: str) -> None:
+        assert i == len(self.roles)
+        self.roles.append(role)
+
+    def add_replica(self, role: str = "decode", warm: bool = True) -> int:
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"a disaggregated replica is 'prefill' or 'decode', "
+                f"got {role!r}")
+        return super().add_replica(role=role, warm=warm)
 
     def _build_engines(self, lm, num_replicas: int,
                        engine_kw: dict) -> List[ServeEngine]:
@@ -268,7 +293,9 @@ class DisaggRouter(Router):
         draws one verdict per delivery attempt."""
         import time as _time
 
-        for i in range(self.prefill_replicas):
+        for i, role in enumerate(self.roles):
+            if role != "prefill":
+                continue
             eng = self.engines[i]
             if not eng.outbox:
                 continue

@@ -100,7 +100,7 @@ import os
 import time
 from collections import deque
 from collections.abc import MutableMapping
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -201,6 +201,41 @@ class Rejected:
     retry_after_blocks: int
     queue_depth: int
     reason: str = "queue_full"
+
+
+@dataclasses.dataclass
+class ReplicaLoad:
+    """One typed load summary per engine/replica (ISSUE 12 satellite):
+    the SAME struct feeds router placement (``Router._load_score``), the
+    autoscaling policy's signals, the router's ``replica_states()`` cards
+    and the incident bundle's ``state_summary()`` — one shape instead of
+    three ad-hoc dict readings of the same scheduler state. Every field is
+    a deterministic block-clock quantity except ``slo_alerting``, which is
+    only as deterministic as the objectives the monitor watches (see
+    observability/slo.py)."""
+
+    role: str
+    queue_depth: int                 # queued, not yet admitted
+    prefilling: int                  # mid-chunked-prefill slots
+    replays: int                     # pending recovery replays
+    backlog: int                     # queue + prefilling + replays
+    active_slots: int
+    free_slots: int
+    # 0 when a free slot + pool room could take typical work NOW, else the
+    # soonest-retirement estimate plus the backlog (blocks); placement
+    # refines the zero case per-request via _pool_can_admit
+    est_ttft_blocks: int
+    pool_retry_after_blocks: int
+    pages_in_use: Optional[int] = None     # None without a paged pool
+    pages_free: Optional[int] = None
+    tier_pages: Optional[int] = None       # None without a host tier
+    adapters_resident: Optional[List[str]] = None   # None without LoRA
+    slo_alerting: bool = False       # any burn rule latched right now
+    decode_blocks: int = 0
+    inserted_requests: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 @dataclasses.dataclass
@@ -1331,7 +1366,10 @@ class ServeEngine:
             self.session, np.asarray(slot_ids, np.int32), ids, lengths=lens,
             pad_token_id=self.pad_token_id,
             reserve_tokens=reserve if self.paged else None,
-            adapter_slots=aslots))
+            adapter_slots=aslots,
+            # adapter namespace for the radix walk — prefix KV reuse is
+            # scoped per adapter (cross-adapter reuse = wrong tokens)
+            ns=[r.adapter for r in group] if self.paged else None))
         self._note_tier_restore(group, tier_before)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
@@ -1383,7 +1421,8 @@ class ServeEngine:
             reserve = (0 if self.role == "prefill"
                        else req.max_new_tokens + self.block_steps)
             chunk = self.session.paged.begin_chunked(
-                req.prompt.tolist(), req.prompt.size + reserve)
+                req.prompt.tolist(), req.prompt.size + reserve,
+                ns=req.adapter)
             written = chunk.start           # prefix hit: skip reused pages
             self._note_tier_restore([req], tier_before)
         req.start_block = self.blocks
@@ -1587,7 +1626,8 @@ class ServeEngine:
             tier_before = self._tier_marker()
             st = pkv.begin_chunked(
                 seq.tolist(),
-                total + (req.max_new_tokens - g) + self.block_steps)
+                total + (req.max_new_tokens - g) + self.block_steps,
+                ns=req.adapter)
             written = st.start
             self._note_tier_restore([req], tier_before)
         logits = None
@@ -2458,6 +2498,43 @@ class ServeEngine:
         engine was built without ``slos``)."""
         return None if self._slo is None else self._slo.status()
 
+    def load_summary(self) -> ReplicaLoad:
+        """The engine's current load as the shared :class:`ReplicaLoad`
+        struct — router placement, the autoscaler policy and the incident
+        state card all read THIS instead of ad-hoc attribute pokes."""
+        free = len(self._free_slots())
+        backlog = (len(self.queue) + len(self._prefilling)
+                   + len(self._replay_q))
+        pkv = self.session.paged if self.paged else None
+        pages_in_use = pkv.allocator.in_use() if pkv is not None else None
+        pages_free = pkv.allocator.available() if pkv is not None else None
+        retry = self._pool_retry_after()
+        est = (0 if (free > len(self.queue)
+                     and backlog - len(self.queue) == 0
+                     and (pages_free is None or pages_free > 0))
+               else retry + backlog)
+        return ReplicaLoad(
+            role=self.role,
+            queue_depth=len(self.queue),
+            prefilling=len(self._prefilling),
+            replays=len(self._replay_q),
+            backlog=backlog,
+            active_slots=int(sum(1 for r in self.slots if r is not None)),
+            free_slots=free,
+            est_ttft_blocks=int(est),
+            pool_retry_after_blocks=int(retry),
+            pages_in_use=pages_in_use,
+            pages_free=pages_free,
+            tier_pages=(pkv.tier_pages()
+                        if pkv is not None and pkv.tier is not None
+                        else None),
+            adapters_resident=(sorted(self.session.adapters.resident)
+                               if self.lora else None),
+            slo_alerting=(self._slo is not None and self._slo.alerting()),
+            decode_blocks=int(self.stats["decode_blocks"]),
+            inserted_requests=int(self.stats["inserted_requests"]),
+        )
+
     def state_summary(self) -> dict:
         """One JSON-able card of the scheduler's current state — the
         incident bundle's engine section (and a debugging surface in its
@@ -2475,18 +2552,23 @@ class ServeEngine:
                 "prefilling": slot in self._prefilling,
                 "done": bool(self._done[slot]),
             })
+        load = self.load_summary()
         out = {
             "engine": self.lane,
             "role": self.role,
             "blocks": int(self.blocks),
-            "queue_depth": len(self.queue),
+            "queue_depth": load.queue_depth,
             "arrived_depth": sum(1 for r in self.queue
                                  if r.arrival_block <= self.blocks),
-            "prefilling": len(self._prefilling),
-            "replay_pending": len(self._replay_q),
+            "prefilling": load.prefilling,
+            "replay_pending": load.replays,
             "slots": slots,
             "completed": len(self.completed),
             "rejected": len(self.rejected),
+            # the shared typed card (ReplicaLoad) — same struct placement
+            # and the autoscaler read, nested whole so an incident bundle
+            # shows exactly what the policy saw
+            "load": load.to_dict(),
             "stats": dict(self.stats),
         }
         pkv = self.session.paged if self.paged else None
@@ -2549,54 +2631,59 @@ class ServeEngine:
         return self.completed
 
 
-def synthetic_trace(num_requests: int, vocab_size: int, *,
-                    prompt_lens=(8, 16), max_new_tokens: int = 16,
-                    mean_interarrival_blocks: float = 0.5,
-                    eos_token_id: Optional[int] = None,
-                    shared_prefix_len: int = 0,
-                    prefix_families: int = 1,
-                    long_prompt_frac: float = 0.0,
-                    long_prompt_len: int = 0,
-                    ttft_deadline_ms: Optional[float] = None,
-                    deadline_ms: Optional[float] = None,
-                    tenants: int = 0,
-                    tenant_skew: float = 1.0,
-                    adapters: int = 0,
-                    adapter_skew: float = 1.0,
-                    seed: int = 0) -> List[dict]:
-    """Deterministic synthetic arrival trace (virtual time in blocks):
-    exponential inter-arrivals, prompt lengths cycled through
-    ``prompt_lens`` — the multi-tenant workload shape the serving bench and
-    the ``runner.py serve`` entrypoint replay. ``shared_prefix_len > 0``
-    prepends a common random prefix of that many tokens to every prompt
-    (the system-prompt / few-shot-header workload shape the paged engine's
-    prefix cache exists for; prompt_lens then size the per-request tail);
-    ``prefix_families > 1`` rotates through that many DISTINCT prefixes in
-    runs of four consecutive requests (A A A A B B B B A ...) — the
-    working-set-larger-than-the-pool workload the host tier exists for:
-    the idle family's prefix goes cold, spills, and must restore (or
-    re-prefill) when its run comes around again.
+def synthetic_trace_stream(num_requests: int, vocab_size: int, *,
+                           prompt_lens=(8, 16), max_new_tokens: int = 16,
+                           mean_interarrival_blocks: float = 0.5,
+                           eos_token_id: Optional[int] = None,
+                           shared_prefix_len: int = 0,
+                           prefix_families: int = 1,
+                           long_prompt_frac: float = 0.0,
+                           long_prompt_len: int = 0,
+                           ttft_deadline_ms: Optional[float] = None,
+                           deadline_ms: Optional[float] = None,
+                           tenants: int = 0,
+                           tenant_skew: float = 1.0,
+                           adapters: int = 0,
+                           adapter_skew: float = 1.0,
+                           diurnal: float = 0.0,
+                           diurnal_period_blocks: int = 64,
+                           burst_every: int = 0,
+                           burst_mult: float = 4.0,
+                           seed: int = 0) -> Iterator[dict]:
+    """STREAMED deterministic synthetic arrival trace (virtual time in
+    blocks): a generator yielding one request dict at a time — no
+    materialized request list, so a 1M-request soak holds O(1) trace
+    memory (the ROADMAP #18 down-payment; ``synthetic_trace`` below is the
+    list-materializing wrapper every existing caller keeps using, and
+    ``run_router_trace`` accepts the raw generator, submitting each
+    request only when the clock reaches its arrival).
 
-    ``long_prompt_frac > 0`` makes the prompt-length distribution heavy-
-    tailed: every ``round(1/frac)``-th request (never the first, so decode
-    traffic is already live when the first long prompt arrives) carries a
-    ``long_prompt_len``-token prompt instead — the prefill/decode
-    interference workload ``prefill_chunk_tokens`` exists for.
+    Arrival-rate modulation (ISSUE 12 — the autoscaling workload shapes;
+    both default OFF, and OFF is draw-for-draw identical to the historic
+    trace for any seed):
 
-    ``tenants > 0`` labels each request with a tenant drawn from a
-    Zipf-skewed distribution over ``t0..t<tenants-1>`` (P(rank k) ∝
-    1/(k+1)^tenant_skew — t0 is the heavy hitter; skew 0 is uniform): the
-    multi-tenant burst workload the Router's weighted fair queueing and
-    tenant-aware shedding exist for. ``run_trace``/``run_router_trace``
-    then report the per-tenant latency/goodput surface.
-
-    ``adapters > 0`` labels each request with an adapter name drawn from
-    its own Zipf distribution over ``a0..a<adapters-1>`` (independent
-    stream — adding adapter labels never shifts the tenant draws): the
-    every-user-their-own-fine-tune workload of the multi-LoRA pool. Low
-    ``adapter_skew`` spreads traffic across adapters (pool churn when the
-    pool holds fewer), high skew concentrates it (a0 stays hot). The
-    caller must ``register_adapter`` every name the trace uses."""
+    * ``diurnal`` in [0, 1): the instantaneous arrival rate is scaled by
+      ``1 + diurnal * sin(2*pi*t / diurnal_period_blocks)`` — a smooth
+      day/night load curve on the virtual clock (peak early in each
+      period, trough in the second half). The mean stays
+      ``mean_interarrival_blocks``-ish; the POINT is that a fixed fleet
+      provisioned for the peak idles through the trough.
+    * ``burst_every`` > 0: during the first quarter of every
+      ``burst_every``-block window, arrivals come ``burst_mult``x faster —
+      the square-wave flash-crowd shape that exercises scale-up patience
+      and cooldown (a one-block spike must not spawn a replica; a
+      sustained burst must).
+    """
+    import math
+    if not 0.0 <= diurnal < 1.0:
+        raise ValueError(f"diurnal must be in [0, 1), got {diurnal}")
+    if diurnal_period_blocks < 1:
+        raise ValueError(f"diurnal_period_blocks must be >= 1, got "
+                         f"{diurnal_period_blocks}")
+    if burst_every < 0:
+        raise ValueError(f"burst_every must be >= 0, got {burst_every}")
+    if burst_mult <= 0:
+        raise ValueError(f"burst_mult must be > 0, got {burst_mult}")
     if long_prompt_frac < 0 or long_prompt_frac > 1:
         raise ValueError(f"long_prompt_frac must be in [0, 1], got {long_prompt_frac}")
     if long_prompt_frac > 0 and long_prompt_len < 1:
@@ -2627,9 +2714,17 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
                              dtype=np.float64) ** adapter_skew
         adapter_p = wa / wa.sum()
     t = 0.0
-    trace = []
     for i in range(num_requests):
-        t += rs.exponential(mean_interarrival_blocks)
+        # instantaneous rate modulation (both factors 1.0 when off — the
+        # exponential draw then consumes the identical scale, keeping the
+        # stream draw-for-draw equal to the historic trace)
+        rate = 1.0
+        if diurnal > 0:
+            rate *= max(1.0 + diurnal * math.sin(
+                2.0 * math.pi * t / diurnal_period_blocks), 0.05)
+        if burst_every and int(t) % burst_every < max(1, burst_every // 4):
+            rate *= burst_mult
+        t += rs.exponential(mean_interarrival_blocks / rate)
         s = int(prompt_lens[i % len(prompt_lens)])
         if long_every and i % long_every == long_every - 1:
             s = int(long_prompt_len)
@@ -2637,7 +2732,7 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
         if tenant_p is not None:
             trace_tenant = f"t{int(rs.choice(tenants, p=tenant_p))}"
         prefix = prefixes[(i // 4) % prefix_families]
-        trace.append({
+        item = {
             "prompt": np.concatenate([prefix, tail]) if shared_prefix_len else tail,
             "max_new_tokens": max_new_tokens,
             "eos_token_id": eos_token_id,
@@ -2646,13 +2741,54 @@ def synthetic_trace(num_requests: int, vocab_size: int, *,
             # attaches these to measure deadline-miss rate and goodput
             "ttft_deadline_ms": ttft_deadline_ms,
             "deadline_ms": deadline_ms,
-        })
+        }
         if tenant_p is not None:
-            trace[-1]["tenant"] = trace_tenant
+            item["tenant"] = trace_tenant
         if adapter_p is not None:
-            trace[-1]["adapter"] = \
+            item["adapter"] = \
                 f"a{int(adapter_rs.choice(adapters, p=adapter_p))}"
-    return trace
+        yield item
+
+
+def synthetic_trace(num_requests: int, vocab_size: int,
+                    **kw) -> List[dict]:
+    """Deterministic synthetic arrival trace (virtual time in blocks):
+    exponential inter-arrivals, prompt lengths cycled through
+    ``prompt_lens`` — the multi-tenant workload shape the serving bench and
+    the ``runner.py serve`` entrypoint replay. This is the materializing
+    wrapper over :func:`synthetic_trace_stream` (same knobs, same draws —
+    see there for the streamed form and the ``diurnal``/``burst_every``
+    arrival-rate modulation). ``shared_prefix_len > 0``
+    prepends a common random prefix of that many tokens to every prompt
+    (the system-prompt / few-shot-header workload shape the paged engine's
+    prefix cache exists for; prompt_lens then size the per-request tail);
+    ``prefix_families > 1`` rotates through that many DISTINCT prefixes in
+    runs of four consecutive requests (A A A A B B B B A ...) — the
+    working-set-larger-than-the-pool workload the host tier exists for:
+    the idle family's prefix goes cold, spills, and must restore (or
+    re-prefill) when its run comes around again.
+
+    ``long_prompt_frac > 0`` makes the prompt-length distribution heavy-
+    tailed: every ``round(1/frac)``-th request (never the first, so decode
+    traffic is already live when the first long prompt arrives) carries a
+    ``long_prompt_len``-token prompt instead — the prefill/decode
+    interference workload ``prefill_chunk_tokens`` exists for.
+
+    ``tenants > 0`` labels each request with a tenant drawn from a
+    Zipf-skewed distribution over ``t0..t<tenants-1>`` (P(rank k) ∝
+    1/(k+1)^tenant_skew — t0 is the heavy hitter; skew 0 is uniform): the
+    multi-tenant burst workload the Router's weighted fair queueing and
+    tenant-aware shedding exist for. ``run_trace``/``run_router_trace``
+    then report the per-tenant latency/goodput surface.
+
+    ``adapters > 0`` labels each request with an adapter name drawn from
+    its own Zipf distribution over ``a0..a<adapters-1>`` (independent
+    stream — adding adapter labels never shifts the tenant draws): the
+    every-user-their-own-fine-tune workload of the multi-LoRA pool. Low
+    ``adapter_skew`` spreads traffic across adapters (pool churn when the
+    pool holds fewer), high skew concentrates it (a0 stays hot). The
+    caller must ``register_adapter`` every name the trace uses."""
+    return list(synthetic_trace_stream(num_requests, vocab_size, **kw))
 
 
 def per_tenant_report(completions: List[Completion],
@@ -2712,6 +2848,10 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     tracing on when the engine was built without it. Callers measuring the
     untraced fast path (the tracing-overhead bench) drive ``engine.run()``
     directly."""
+    if not isinstance(trace, (list, tuple)):
+        # single-engine runs materialize a streamed trace (the streamed
+        # submit-at-arrival path lives in run_router_trace)
+        trace = list(trace)
     if not engine.tracer.enabled:
         engine.tracer.enabled = True
     tenant_of: Dict[int, str] = {}

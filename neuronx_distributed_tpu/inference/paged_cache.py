@@ -220,6 +220,22 @@ class PageAllocator:
         return freed
 
 
+def _ns_tokens(tokens: Sequence[int], ns: Optional[str]) -> list:
+    """Adapter-namespaced radix key stream (ISSUE 12 fix): a prefix's KV
+    is a function of (tokens, adapter) — every layer's K/V projections run
+    under the request's low-rank correction — so reusing a prefix built
+    under one adapter (or the identity base model) for a request pinned to
+    another would serve WRONG TOKENS. The trie keys on tuples of stream
+    elements, so salting each token with the adapter NAME (names are
+    stable; pool slot indices churn with LRU) partitions the trie into
+    per-adapter namespaces: same-adapter traffic keeps full radix reuse,
+    cross-adapter traffic never matches. ``ns=None`` (the base model, and
+    every pre-LoRA call path) is byte-for-byte the historic key stream."""
+    if ns is None:
+        return list(tokens)
+    return [(ns, int(t)) for t in tokens]
+
+
 class _Node:
     """One cached prompt page. Residency states: ``page >= 0`` — device-
     resident (holds one allocator refcount); ``page < 0`` with a
@@ -805,7 +821,8 @@ class PagedKVCache:
 
     # --- admission lifecycle --------------------------------------------
 
-    def plan(self, tokens: Sequence[int], reserve_total: int) -> InsertPlan:
+    def plan(self, tokens: Sequence[int], reserve_total: int,
+             ns: Optional[str] = None) -> InsertPlan:
         """Plan one admission: longest page-aligned cached prefix (clamped
         below the last prompt token, so suffix prefill is never empty —
         tiered entries are RESTORED into fresh device pages as the pool
@@ -814,8 +831,11 @@ class PagedKVCache:
         pages move to the host tier) → restore-budget (the reused prefix
         shortens rather than shed) → evict-drop, and only then
         :class:`PagePoolExhausted`. Holds are taken here — pair every plan
-        with :meth:`commit` or :meth:`rollback`."""
+        with :meth:`commit` or :meth:`rollback`. ``ns`` is the request's
+        adapter namespace — see :func:`_ns_tokens`; pass the SAME ns to
+        the paired :meth:`commit`."""
         ps = self.page_size
+        tokens = _ns_tokens(tokens, ns)
         plen = len(tokens)
         if plen < 1:
             raise ValueError("empty prompt")
@@ -854,16 +874,19 @@ class PagedKVCache:
         t[t < 0] = self.scratch[slot]
         return t
 
-    def commit(self, slot: int, plan: InsertPlan, tokens: Sequence[int]) -> None:
+    def commit(self, slot: int, plan: InsertPlan, tokens: Sequence[int],
+               ns: Optional[str] = None) -> None:
         """Install the plan on ``slot`` (releasing whatever it held) and
-        register the prompt's fully-covered pages in the prefix index."""
+        register the prompt's fully-covered pages in the prefix index —
+        under the same adapter namespace the plan walked."""
         self.release(slot)
         self.tables[slot] = self.table_for(slot, plan)
         self._slot_pages[slot] = plan.shared + plan.owned
         if self.prefix is not None:
             n_full = plan.prompt_len // self.page_size
-            self.prefix.register(list(tokens)[: n_full * self.page_size],
-                                 [int(p) for p in self.tables[slot, :n_full]])
+            self.prefix.register(
+                _ns_tokens(tokens, ns)[: n_full * self.page_size],
+                [int(p) for p in self.tables[slot, :n_full]])
         self.stats["pages_in_use_peak"] = max(
             self.stats["pages_in_use_peak"], self.allocator.in_use())
 
@@ -879,7 +902,7 @@ class PagedKVCache:
 
     def adopt_pages(self, slot: int, tokens: Sequence[int],
                     payloads: Sequence[Dict[str, np.ndarray]], write_pages,
-                    reserve_total: int) -> List[int]:
+                    reserve_total: int, ns: Optional[str] = None) -> List[int]:
         """Adopt a migrated prompt's KV pages (prefill/decode
         disaggregation, ``inference/disagg.py``): allocate the slot's FULL
         footprint (prompt + decode reserve, reclaim-first like every other
@@ -895,6 +918,7 @@ class PagedKVCache:
         unwritten pages. Raises :class:`PagePoolExhausted` with NOTHING
         allocated (the caller defers and retries as streams retire)."""
         ps = self.page_size
+        tokens = _ns_tokens(tokens, ns)
         plen = len(tokens)
         if plen < 1:
             raise ValueError("empty prompt")
@@ -933,16 +957,19 @@ class PagedKVCache:
     # without ever holding pages it has not yet written. Every path pairs:
     # begin -> extend* -> finish  |  begin -> extend* -> abort.
 
-    def begin_chunked(self, tokens: Sequence[int],
-                      reserve_total: int) -> ChunkedPrefill:
+    def begin_chunked(self, tokens: Sequence[int], reserve_total: int,
+                      ns: Optional[str] = None) -> ChunkedPrefill:
         """Open a chunked admission: prefix walk (the reused pages are
         retained so mid-prefill reclaim cannot free them; tiered entries
         restore as the pool affords — a restore mid-chunked-prefill is just
         an earlier ``start``) but NO owned pages yet — allocation happens
         per chunk in :meth:`extend_chunked`. Cannot raise
         :class:`PagePoolExhausted` (a failed restore only shortens the
-        reused prefix)."""
+        reused prefix). ``ns``: adapter namespace (:func:`_ns_tokens`) —
+        the namespaced stream rides ``state.tokens`` so finish registers
+        consistently."""
         ps = self.page_size
+        tokens = _ns_tokens(tokens, ns)
         plen = len(tokens)
         if plen < 1:
             raise ValueError("empty prompt")
@@ -1026,7 +1053,8 @@ class PagedKVCache:
 
     # --- introspection ---------------------------------------------------
 
-    def prefix_peek(self, tokens: Sequence[int]) -> int:
+    def prefix_peek(self, tokens: Sequence[int],
+                    ns: Optional[str] = None) -> int:
         """Length in TOKENS of the cached page-aligned prefix an admission
         of ``tokens`` would reuse — WITHOUT admitting: no hold taken, no
         stats counted, no LRU touch (``RadixPrefixIndex.peek``). The
@@ -1039,7 +1067,8 @@ class PagedKVCache:
         plen = len(tokens)
         if plen < 1:
             return 0
-        hit = self.prefix.peek(list(tokens))[: (plen - 1) // self.page_size]
+        hit = self.prefix.peek(
+            _ns_tokens(tokens, ns))[: (plen - 1) // self.page_size]
         return len(hit) * self.page_size
 
     def live_pages(self) -> List[int]:

@@ -178,6 +178,7 @@ class Router:
         record_streams: bool = True,
         faults: Optional[Union[FaultPlan, FaultInjector]] = None,
         crash_at: Sequence[Tuple[int, int]] = (),
+        autoscaler=None,
         rng: Optional[jax.Array] = None,
         trace: bool = False,
         tracer: Optional[Tracer] = None,
@@ -226,6 +227,10 @@ class Router:
         # the fleet: one lm (shared compiled programs), N sessions. All
         # replicas take the SAME rng base — with router-assigned globally-
         # unique ids that makes streams replica-independent by construction.
+        # lm + engine_kw are retained: the autoscaler spawns replicas with
+        # the SAME recipe mid-run (homogeneous fleet by construction)
+        self.lm = lm
+        self._engine_kw = dict(engine_kw)
         self.engines: List[ServeEngine] = self._build_engines(
             lm, num_replicas, engine_kw)
         self.crash_at = [(int(b), int(i)) for b, i in crash_at]
@@ -261,16 +266,31 @@ class Router:
         self._rr_next = 0
         self.last_failover_ms: Optional[float] = None
         self.last_drain_ms: Optional[float] = None
+        # elastic-fleet bookkeeping (inference/autoscale.py): the policy
+        # object evaluated once per block, per-replica first-placement
+        # blocks (the scale-up time-to-ready surface), the fleet-wide
+        # LoRA registry re-applied to spawned replicas, and the last
+        # spawn's wall cost (the only non-deterministic scale quantity —
+        # it stays OUT of the scale-event log)
+        self.autoscaler = autoscaler
+        self._first_place_block: Dict[int, int] = {}
+        self._adapter_registry: Dict[str, Tuple] = {}
+        self.last_spawn: Dict[str, object] = {}
         self.stats = {
             "placements": 0, "affinity_placements": 0, "requeues": 0,
             "rejected": 0, "shed_evictions": 0, "crashes": 0,
             "heartbeat_misses": 0, "failovers": 0, "failed_over_requests": 0,
             "drains": 0, "drain_migrated_requests": 0, "snapshots_taken": 0,
+            "scale_ups": 0, "scale_downs": 0, "warm_spawns": 0,
+            "cold_spawns": 0, "replica_blocks": 0,
         }
         self._m_pending = self.metrics.gauge(
             "router_pending_depth", help="arrived router backlog")
         self._m_placements = self.metrics.counter(
             "router_placements_total", help="requests placed on replicas")
+        self._m_replicas = self.metrics.gauge(
+            "serve_replicas_active", help="live (placeable) replicas")
+        self._m_replicas.set(len(self._live_replicas()))
 
     def _build_engines(self, lm, num_replicas: int,
                        engine_kw: dict) -> List[ServeEngine]:
@@ -282,6 +302,110 @@ class Router:
                         **engine_kw)
             for i in range(num_replicas)
         ]
+
+    # --- elastic fleet membership (inference/autoscale.py) ----------------
+
+    def role_of(self, i: int) -> str:
+        """Replica ``i``'s disaggregation role ('both' on a classic
+        homogeneous fleet) — the pool key autoscaling groups by."""
+        return getattr(self.engines[i], "role", "both")
+
+    def fleet_roles(self) -> List[str]:
+        """The distinct role pools this fleet runs (['both'] classically;
+        ['decode', 'prefill'] disaggregated) in deterministic order."""
+        return sorted({self.role_of(i) for i in range(len(self.engines))})
+
+    def add_replica(self, role: str = "both", warm: bool = True) -> int:
+        """Grow the fleet by one replica of ``role``, live, mid-run. WARM
+        reuse first: a parked (drained) replica of the same role restores
+        from its snapshot via :meth:`ServeEngine.from_snapshot` — same
+        index, same rng base, scheduler state replayed; otherwise a COLD
+        engine appends at a fresh index. Either way the shared lm means no
+        new compiles, registered adapters are re-registered, and the
+        replica is placeable from THIS block. Returns the replica index;
+        ``last_spawn`` records {replica, warm, spawn_ms} (the wall cost is
+        deliberately outside the deterministic scale-event log)."""
+        t0 = time.perf_counter()
+        idx = None
+        if warm:
+            for i in sorted(self._drained):
+                if i in self.snapshots and self.role_of(i) == role:
+                    idx = self._unpark(i)
+                    break
+        was_warm = idx is not None
+        if idx is None:
+            idx = self._spawn(role)
+        self._first_place_block.pop(idx, None)
+        spawn_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self.stats["scale_ups"] += 1
+        self.stats["warm_spawns" if was_warm else "cold_spawns"] += 1
+        self.last_spawn = {"replica": idx, "warm": was_warm,
+                           "spawn_ms": spawn_ms}
+        self.metrics.gauge(
+            "serve_scaleup_spawn_ms",
+            help="last replica spawn wall ms (warm restore or cold "
+                 "construct)").set(spawn_ms)
+        self._m_replicas.set(len(self._live_replicas()))
+        return idx
+
+    def _spawn_overrides(self, role: str) -> dict:
+        """Ctor kwargs a snapshot's config section does NOT carry (infra
+        objects + the role), supplied at unpark time so the restored
+        engine is wired exactly like its `_build_engines` siblings."""
+        extra = {k: self._engine_kw[k]
+                 for k in ("slos", "incident", "trace")
+                 if k in self._engine_kw}
+        if role != "both":
+            extra["role"] = role
+        return extra
+
+    def _unpark(self, i: int) -> int:
+        """Warm scale-up: rebuild replica ``i`` from its parked snapshot
+        on a fresh session (the PR 5 restore path — queued work re-enters,
+        in-flight streams would replay bit-identical; a cleanly drained
+        park restores empty) and return it to placement."""
+        eng = ServeEngine.from_snapshot(
+            self.lm, self.snapshots[i],
+            adapters=(dict(self._adapter_registry)
+                      if self._adapter_registry else None),
+            name=f"replica{i}", tracer=self.tracer, faults=self._injector,
+            **self._spawn_overrides(self.role_of(i)))
+        self.engines[i] = eng
+        self._drained.discard(i)
+        self._alive[i] = True
+        self._hb[i] = self.blocks
+        self._hc[i] = 0
+        self._hr[i] = 0
+        return i
+
+    def _spawn(self, role: str) -> int:
+        """Cold scale-up: append a fresh replica at a new index (same
+        recipe as `_build_engines` — shared lm, shared rng base, shared
+        tracer/injector — so the fleet stays homogeneous)."""
+        i = len(self.engines)
+        kw = dict(self._engine_kw)
+        if role != "both":
+            kw["role"] = role
+        eng = ServeEngine(self.lm, rng=self.rng, name=f"replica{i}",
+                          tracer=self.tracer, faults=self._injector, **kw)
+        for name, (lp, lc) in self._adapter_registry.items():
+            eng.register_adapter(name, lp, lc)
+        self.engines.append(eng)
+        self._alive.append(True)
+        self._hb.append(self.blocks)
+        self._hc.append(0)
+        self._hr.append(0)
+        # keep the per-replica wall ledger block-aligned: the newcomer was
+        # provisioned for zero of the elapsed blocks
+        self._eng_block_wall.append(
+            [0.0] * len(self._eng_block_wall[0])
+            if self._eng_block_wall else [])
+        self._note_new_replica(i, role)
+        return i
+
+    def _note_new_replica(self, i: int, role: str) -> None:
+        """Post-append hook — :class:`DisaggRouter` extends its role
+        table here."""
 
     # --- tenants / fairness ----------------------------------------------
 
@@ -326,7 +450,9 @@ class Router:
     def register_adapter(self, name: str, lora_params, lora_config) -> None:
         """Register a LoRA adapter fleet-wide (every replica's pool learns
         the host bytes; device residency stays per-replica — which is what
-        adapter-affinity placement keys on)."""
+        adapter-affinity placement keys on). The registry is retained so
+        replicas the autoscaler spawns later learn the same adapters."""
+        self._adapter_registry[name] = (lora_params, lora_config)
         for eng in self.engines:
             eng.register_adapter(name, lora_params, lora_config)
 
@@ -489,21 +615,17 @@ class Router:
         pool room, else the soonest retirement estimate plus the queued
         backlog), then backlog depth, then fewest pages in use."""
         eng = self.engines[i]
+        load = eng.load_summary()
         adapter_miss = 0
-        if req.adapter is not None and getattr(eng, "lora", False):
-            adapter_miss = 0 if eng.session.adapters.is_resident(
-                req.adapter) else 1
-        free = len(eng._free_slots())
-        backlog = (len(eng.queue) + len(eng._prefilling)
-                   + len(eng._replay_q))
-        if free and backlog == 0 and eng._pool_can_admit(
+        if req.adapter is not None and load.adapters_resident is not None:
+            adapter_miss = 0 if req.adapter in load.adapters_resident else 1
+        if load.free_slots and load.backlog == 0 and eng._pool_can_admit(
                 req.prompt.size, req.max_new_tokens):
             est_ttft = 0
         else:
-            est_ttft = eng._pool_retry_after() + backlog
-        pages = (eng.session.paged.allocator.in_use()
-                 if eng.paged and eng.session.paged is not None else 0)
-        return (adapter_miss, est_ttft, backlog, -free, pages, i)
+            est_ttft = load.pool_retry_after_blocks + load.backlog
+        return (adapter_miss, est_ttft, load.backlog, -load.free_slots,
+                load.pages_in_use or 0, i)
 
     def _viable_replicas(self, e: _Entry) -> List[int]:
         """Live replicas that can take this entry right now — the seam
@@ -528,7 +650,10 @@ class Router:
             for i in viable:
                 pkv = self.engines[i].session.paged
                 if pkv is not None:
-                    hits[i] = pkv.prefix_peek(e.req.prompt.tolist())
+                    # affinity probes under the request's adapter namespace:
+                    # only a SAME-adapter prefix is a real hit
+                    hits[i] = pkv.prefix_peek(e.req.prompt.tolist(),
+                                              ns=e.req.adapter)
             best = max(hits.values()) if hits else 0
             if best > 0:
                 hot = [i for i, h in hits.items() if h == best]
@@ -542,6 +667,7 @@ class Router:
                 continue
             eng = self.engines[i]
             rec = self._records.get(e.req.request_id)
+            self._first_place_block.setdefault(i, self.blocks)
             if e.replay:
                 eng.resume(e.req, e.generated)
                 out: Union[int, Rejected] = e.req.request_id
@@ -812,9 +938,13 @@ class Router:
     def _observe_block(self) -> None:
         depth = sum(1 for e in self.pending if self._arrived(e))
         self._m_pending.set(depth)
+        live = len(self._live_replicas())
+        self._m_replicas.set(live)
         if self.tracer.enabled:
             self.tracer.counter("router_pending", ("router", "clock"),
                                 depth, block=self.blocks)
+            self.tracer.counter("replicas_active", ("router", "scale"),
+                                live, block=self.blocks)
 
     def step_block(self) -> bool:
         """One router round on the shared clock: inject/detect crashes,
@@ -824,6 +954,11 @@ class Router:
         self._inject_crashes()
         self._detect_failures()
         self._finish_drains()
+        if self.autoscaler is not None:
+            # the policy runs AFTER drain completion (parked snapshots are
+            # warm-spawn images) and BEFORE placement (spawned capacity
+            # takes this very block's arrivals) — all on the block clock
+            self.autoscaler.observe_block(self)
         self._place()
         progressed = False
         for i, eng in enumerate(self.engines):
@@ -831,6 +966,10 @@ class Router:
                     or i in self._drained):
                 self._eng_block_wall[i].append(0.0)
                 continue
+            # provisioned-capacity ledger: every stepped replica (draining
+            # ones included — they still hold hardware) is one replica-
+            # block, the denominator of goodput-per-provisioned-capacity
+            self.stats["replica_blocks"] += 1
             eng.blocks = self.blocks
             t0 = time.perf_counter()
             if eng.step_block():
@@ -898,6 +1037,10 @@ class Router:
         return _attribution.explain_deadline_miss(self.tracer, request_id)
 
     def replica_states(self) -> List[dict]:
+        """Per-replica cards: router-level membership state + heartbeat
+        layered over the engine's typed :class:`ReplicaLoad` summary (one
+        struct shared with placement `_load_score`, the autoscaler policy
+        and the incident state card — ISSUE 12 satellite)."""
         out = []
         for i, eng in enumerate(self.engines):
             state = ("dark" if i in self._dark
@@ -906,45 +1049,40 @@ class Router:
                      else "live" if self._alive[i] else "dead")
             out.append({
                 "replica": i, "state": state,
-                # disaggregation role ("both" on a classic homogeneous
-                # fleet): what kind of work placement may hand this replica
-                "role": getattr(eng, "role", "both"),
                 "last_heartbeat_block": self._hb[i],
-                "queue_depth": len(eng.queue),
-                "active_slots": int(sum(1 for r in eng.slots
-                                        if r is not None)),
-                "decode_blocks": int(eng.stats["decode_blocks"]),
-                "inserted_requests": int(eng.stats["inserted_requests"]),
-                "pages_in_use": (eng.session.paged.allocator.in_use()
-                                 if eng.paged and eng.session.paged
-                                 is not None else None),
-                # host-tier residency (None without a tier): prefix-affinity
-                # peeks count tiered prefixes as hot, so a replica's tier
-                # content is placement-relevant state worth surfacing
-                "tier_pages": (eng.session.paged.tier_pages()
-                               if eng.paged and eng.session.paged is not None
-                               and eng.session.paged.tier is not None
-                               else None),
-                # device-resident adapters (None without a multi-LoRA pool):
-                # the state adapter-affinity placement keys on
-                "adapters_resident": (
-                    sorted(eng.session.adapters.resident)
-                    if getattr(eng, "lora", False) else None),
+                # the shared load struct flattens in whole: role, queue /
+                # backlog depths, est TTFT, free/tier pages, resident
+                # adapters, burn status — everything the policy layers see
+                **eng.load_summary().to_dict(),
             })
         return out
 
 
-def run_router_trace(router: Router, trace: List[dict],
+def run_router_trace(router: Router, trace,
                      max_blocks: Optional[int] = None) -> dict:
     """Submit a synthetic trace to the Router and drive the fleet to
     completion; returns the serving report in ``run_trace``'s shape plus
     the router surface (per-replica states, placements, failovers, drains)
     and the per-tenant isolation table. Turns tracing on (the wall
     ITL surface reads the shared tracer's token events) exactly like
-    ``run_trace``."""
+    ``run_trace``.
+
+    ``trace`` is a list (submitted up-front, the historic shape) or ANY
+    iterator — e.g. the raw :func:`synthetic_trace_stream` generator: the
+    streamed form pulls one item at a time and submits it only once the
+    shared clock reaches its arrival block, so the request list is never
+    materialized (ROADMAP #18 down-payment) and the run keeps the clock
+    alive through arrival gaps — the idle valleys autoscaling scales down
+    into. Token streams are identical either way (the per-request rng
+    contract); WFQ tags and wall accounting differ slightly in basis
+    (streamed submission happens inside the timed loop)."""
     if not router.tracer.enabled:
         router.tracer.enabled = True
-    for item in trace:
+    # O(1)-per-request bookkeeping (tenant label + deadline flag) — the
+    # report's denominator; deliberately NOT the items themselves
+    meta: List[Tuple[str, bool]] = []
+
+    def _submit(item):
         router.submit(item["prompt"], item["max_new_tokens"],
                       eos_token_id=item.get("eos_token_id"),
                       arrival_block=item.get("arrival_block", 0),
@@ -952,9 +1090,34 @@ def run_router_trace(router: Router, trace: List[dict],
                       deadline_ms=item.get("deadline_ms"),
                       tenant=item.get("tenant", "default"),
                       adapter=item.get("adapter"))
-    t0 = time.perf_counter()
-    completions = router.run(max_blocks=max_blocks)
-    wall_s = time.perf_counter() - t0
+        meta.append((item.get("tenant", "default"),
+                     bool(item.get("deadline_ms")
+                          or item.get("ttft_deadline_ms"))))
+
+    if isinstance(trace, (list, tuple)):
+        for item in trace:
+            _submit(item)
+        t0 = time.perf_counter()
+        completions = router.run(max_blocks=max_blocks)
+        wall_s = time.perf_counter() - t0
+    else:
+        it = iter(trace)
+        nxt = next(it, None)
+        t0 = time.perf_counter()
+        n = 0
+        while True:
+            while (nxt is not None
+                   and int(nxt.get("arrival_block", 0)) <= router.blocks):
+                _submit(nxt)
+                nxt = next(it, None)
+            more = router.step_block()
+            n += 1
+            if max_blocks is not None and n >= max_blocks:
+                break
+            if not more and nxt is None:
+                break
+        completions = router.completed
+        wall_s = time.perf_counter() - t0
     total_tokens = int(sum(len(c.tokens) for c in completions))
     tok_ts = {
         rid: np.asarray([ev["ts"] for ev in evs if ev["name"] == "tok"],
@@ -965,12 +1128,11 @@ def run_router_trace(router: Router, trace: List[dict],
         ts = tok_ts.get(c.request_id, np.zeros((0,)))
         g = np.diff(ts) * 1e3 if ts.size > 1 else np.zeros((0,))
         gaps_ms.extend(g[g > 0.0].tolist())
-    submitted = len(trace)
+    submitted = len(meta)
     rejected = len(router.rejected)
     expired = sum(1 for c in completions if c.expired)
     missed = sum(1 for c in completions if c.deadline_missed)
-    has_deadlines = any(item.get("deadline_ms")
-                        or item.get("ttft_deadline_ms") for item in trace)
+    has_deadlines = any(flag for _t, flag in meta)
     ontime_tokens = sum(
         len(c.tokens) for c in completions
         if not (c.deadline_missed or c.expired or c.cancelled))
@@ -996,6 +1158,9 @@ def run_router_trace(router: Router, trace: List[dict],
         "ttft_blocks_mean": round(float(np.mean(
             [c.ttft_blocks for c in completions])), 2)
         if completions else None,
+        # provisioned capacity actually consumed (replica-blocks): the
+        # denominator of the autoscale-vs-fixed goodput-per-capacity key
+        "replica_blocks": router.stats["replica_blocks"],
         "placements": router.stats["placements"],
         "affinity_placements": router.stats["affinity_placements"],
         "requeues": router.stats["requeues"],
@@ -1044,7 +1209,7 @@ def run_router_trace(router: Router, trace: List[dict],
             "adapter_rejects": sum(
                 int(eng.stats["adapter_rejects"]) for eng in lora_engines),
         })
-    tenants = {item.get("tenant", "default") for item in trace}
+    tenants = {t for t, _flag in meta}
     if tenants != {"default"}:
         report["per_tenant"] = per_tenant_report(
             completions, tok_ts, wall_s,
@@ -1052,4 +1217,8 @@ def run_router_trace(router: Router, trace: List[dict],
              for r in router.rejected])
     if router._injector is not None:
         report["fault_stats"] = dict(router._injector.stats)
+    if router.autoscaler is not None:
+        # elastic-fleet surface: the deterministic scale-event log plus
+        # warm/cold spawn counts and scale-up time-to-ready blocks
+        report["autoscale"] = router.autoscaler.report(router)
     return report

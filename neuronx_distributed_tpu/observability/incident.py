@@ -46,6 +46,9 @@ INCIDENT_KINDS = (
     "dispatch_failstop",
     "replica_crash",
     "slo_burn",
+    # autoscaler fleet mutations (ISSUE 12): capacity changes pinned next
+    # to the burn alerts / backlog that caused them
+    "scale",
     "manual",
 )
 

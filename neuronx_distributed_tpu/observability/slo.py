@@ -216,6 +216,17 @@ class SLOMonitor:
                     self._latched[key] = False
         return fired
 
+    def latched(self) -> List[Tuple[str, str]]:
+        """The (objective, rule-label) pairs alerting RIGHT NOW — the
+        autoscaler's scale-up signal (ISSUE 12: the PR 9 alert becomes an
+        actuator). Sorted, so policy decisions keyed on it are
+        deterministic given deterministic objectives."""
+        return sorted(k for k, v in self._latched.items() if v)
+
+    def alerting(self) -> bool:
+        """True while any burn rule is latched (see :meth:`latched`)."""
+        return any(self._latched.values())
+
     def status(self) -> dict:
         """Dashboard snapshot per objective: overall compliance, the
         current burn rate per rule window, and whether any rule is latched

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""CPU-basis serving-bench driver: the committed ``BENCH_r06.json``
+generator (ISSUE 12 satellite).
+
+The committed BENCH_r0x trajectory is TPU-driver output; rounds 1-5
+predate the PR 4-11 serving keys, so ``scripts/bench_regress.py`` has had
+nothing to gate them against — every serving key lands as ``new_key``
+forever (the ROADMAP perf-trajectory note). This driver produces a
+baseline that DOES carry them: it runs ``bench.bench_serving`` — the real
+measurement code, not a mock — with ``models.llama.LlamaConfig``
+monkeypatched to tiny dims (hidden 128, 2 layers, fp32, vocab kept at
+32000 so traces stay in-range), the same CPU-basis protocol PROFILE.md's
+serving rounds use, and emits the r0x driver-wrapper shape
+(``{"n", "cmd", "rc", "tail", "parsed"}``) with the full report +
+``headline_keys`` in ``parsed``.
+
+Basis honesty: these numbers are tiny-dims CPU wall clock — comparable
+ONLY against another run of this script (same dims, same backend; the
+``env`` section and ``serve_cpu_basis`` note make that machine-checkable).
+Cross-basis comparisons against the TPU rounds are meaningless and the
+artifact says so. Ratio/blocks keys (goodput ratios, miss rates,
+``serve_goodput_autoscale_vs_fixed``, ``serve_scaleup_time_to_ready_
+blocks``) are basis-robust: they live on the virtual block clock or
+divide out the hardware.
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models import llama as llama_mod
+
+    real_config = llama_mod.LlamaConfig
+
+    def tiny_config(**kw):
+        # keep the caller's vocab/max_seq_len/bucket geometry; shrink the
+        # compute dims to the shared CPU-basis shape (PROFILE.md rounds)
+        kw.update(hidden_size=128, intermediate_size=256, num_layers=2,
+                  num_heads=4, num_kv_heads=4, dtype=jnp.float32,
+                  param_dtype=jnp.float32, use_flash_attention=False,
+                  remat_policy=None)
+        return real_config(**kw)
+
+    llama_mod.LlamaConfig = tiny_config
+    try:
+        out = bench.bench_serving(layers=2, prompt_len=128, max_batch=4,
+                                  fused_steps=16)
+    finally:
+        llama_mod.LlamaConfig = real_config
+    report = {
+        **out,
+        "env": bench.runtime_env(),
+        "headline_keys": list(bench.HEADLINE_KEYS),
+        "serve_cpu_basis": (
+            "bench_serving at tiny dims (hidden 128, 2 layers, fp32, "
+            "vocab 32000, 4 slots, K=16) on the CPU backend — the "
+            "PROFILE.md serving-round basis; compare only against "
+            "another bench_cpu_basis.py run"),
+    }
+    headline = {k: report[k] for k in bench.HEADLINE_KEYS if k in report}
+    wrapper = {
+        "n": 6,
+        "cmd": "JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py",
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": report,
+    }
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_r06.json"
+    with open(path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in report if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

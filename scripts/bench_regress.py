@@ -66,6 +66,12 @@ RULES: List[Tuple[str, str, float]] = [
     (r"serve_tracing_overhead_ratio", "higher", 0.03),
     (r"serve_goodput_2x_vs_1x", "higher", 0.10),
     (r"serve_multilora_vs_merged", "higher", 0.10),
+    # autoscaling (ISSUE 12): goodput-per-provisioned-replica-block ratio,
+    # higher-better (>= 1.0 means elasticity beat max-provisioning); the
+    # scale-up time-to-ready is in deterministic virtual BLOCKS, so it
+    # gets a tight tolerance (policy changes, not noise, move it)
+    (r"serve_goodput_autoscale_vs_fixed", "higher", 0.10),
+    (r"serve_scaleup_time_to_ready_blocks", "lower", 0.10),
     # prefill/decode disaggregation (ISSUE 11): decode-clock latencies are
     # lower-better like every _ms key; named explicitly so the gate set's
     # intent survives even if the generic timing pattern below shifts

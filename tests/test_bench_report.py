@@ -79,6 +79,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_tenant_p99_fairness_ratio": 1.08,
                                       "serve_failover_replay_ms": 145.0,
                                       "serve_drain_ms": 96.0,
+                                      "serve_goodput_autoscale_vs_fixed": 1.21,
+                                      "serve_scaleup_time_to_ready_blocks": 0.0,
+                                      "serve_autoscale_scale_ups": 3,
+                                      "serve_autoscale_scale_downs": 1,
+                                      "serve_autoscale_warm_spawns": 1,
+                                      "serve_scaleup_spawn_ms": 99.2,
                                       "serve_tokens_per_sec_multilora": 481.0,
                                       "serve_tokens_per_sec_merged_single": 503.0,
                                       "serve_multilora_vs_merged": 0.956,
@@ -187,6 +193,19 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_tenant_p99_fairness_ratio"] <= 1.2
     assert h["serve_failover_replay_ms"] == 145.0
     assert h["serve_drain_ms"] == 96.0
+    # autoscaling keys (ISSUE 12): goodput per provisioned replica-block,
+    # autoscaled over fixed max-provisioned, must clear 1.0 on the diurnal
+    # trace (elasticity tracked load without giving back goodput), and the
+    # scale-up time-to-ready rides the headline in deterministic virtual
+    # blocks; event counts and the spawn wall cost stay sidecar-only
+    assert d["serve_goodput_autoscale_vs_fixed"] == \
+        h["serve_goodput_autoscale_vs_fixed"] == 1.21
+    assert h["serve_goodput_autoscale_vs_fixed"] >= 1.0
+    assert h["serve_scaleup_time_to_ready_blocks"] == 0.0
+    assert "serve_autoscale_scale_ups" not in h
+    assert "serve_scaleup_spawn_ms" not in h
+    assert d["serve_autoscale_scale_ups"] == 3
+    assert d["serve_autoscale_warm_spawns"] >= 1
     # multi-LoRA keys (ISSUE 10): the mixed 8-adapter trace must hold >=
     # 0.9x the single-merged baseline, the switch-overhead price tag rides
     # the headline next to it; raw baseline tok/s and the pool sizing unit
@@ -437,6 +456,70 @@ def test_bench_regress_new_keys_never_gate(tmp_path):
                                 tmp_path / "cand.json")
     assert rc == 0, err
     assert summary["counts"].get("new_key", 0) >= 2
+
+
+def test_bench_regress_committed_r06_gates_serving_keys(tmp_path):
+    """ISSUE 12 satellite: the committed BENCH_r06 sidecar (CPU basis,
+    scripts/bench_cpu_basis.py) carries the PR 4-11 serving keys — which
+    the r05 TPU artifact predates — so the regression gate finally has a
+    serving baseline: r06 vs itself passes, an injected serving-key
+    regression exits 1 naming the key."""
+    doc = json.loads((REPO / "BENCH_r06.json").read_text())
+    assert doc["n"] == 6 and doc["rc"] == 0
+    p = doc["parsed"]
+    # the PR 4-12 serving keys that were un-gated before this artifact
+    for key in ("serve_itl_p99_ms", "serve_goodput_2x_overload",
+                "serve_prefix_hit_ttft_ms_tiered", "serve_multilora_vs_merged",
+                "serve_failover_replay_ms", "serve_itl_p99_ms_disagg",
+                "serve_goodput_autoscale_vs_fixed",
+                "serve_scaleup_time_to_ready_blocks"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    assert p["serve_goodput_autoscale_vs_fixed"] >= 1.0
+    assert "cpu" in p["serve_cpu_basis"].lower()
+    rc, summary, err = _regress(REPO / "BENCH_r06.json",
+                                REPO / "BENCH_r06.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    assert summary["gate_basis"] == "artifact_headline_keys"
+    bad = dict(doc, parsed=dict(p, serve_goodput_2x_overload=p[
+        "serve_goodput_2x_overload"] * 0.5))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r06.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert [r["key"] for r in summary["regressions"]] == \
+        ["serve_goodput_2x_overload"]
+
+
+def test_bench_regress_autoscale_direction_rules(tmp_path):
+    """Direction-of-goodness for the autoscale keys: a FALLING
+    goodput-per-capacity ratio or a RISING time-to-ready regresses; the
+    reverse improves."""
+    keys = ["serve_goodput_autoscale_vs_fixed",
+            "serve_scaleup_time_to_ready_blocks"]
+    base = {"headline_keys": keys, "serve_goodput_autoscale_vs_fixed": 1.25,
+            "serve_scaleup_time_to_ready_blocks": 2.0}
+    worse = {"headline_keys": keys, "serve_goodput_autoscale_vs_fixed": 0.9,
+             "serve_scaleup_time_to_ready_blocks": 2.0}
+    slow = {"headline_keys": keys, "serve_goodput_autoscale_vs_fixed": 1.25,
+            "serve_scaleup_time_to_ready_blocks": 4.0}
+    better = {"headline_keys": keys, "serve_goodput_autoscale_vs_fixed": 1.5,
+              "serve_scaleup_time_to_ready_blocks": 1.0}
+    for name, doc in (("base", base), ("worse", worse), ("slow", slow),
+                      ("better", better)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "worse.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_goodput_autoscale_vs_fixed"
+    assert summary["regressions"][0]["direction"] == "higher"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "slow.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_scaleup_time_to_ready_blocks"
+    assert summary["regressions"][0]["direction"] == "lower"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "better.json")
+    assert rc == 0 and summary["counts"]["improved"] == 2
 
 
 def test_bench_regress_disagg_direction_rules(tmp_path):

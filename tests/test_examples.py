@@ -351,6 +351,44 @@ def test_inference_runner_serve_multilora_tiny(capsys):
     assert report["adapter_bytes_per_slot"] > 0
 
 
+def test_inference_runner_serve_autoscale_tiny(capsys, tmp_path):
+    """ISSUE 12 CI gate: runner.py serve --autoscale drives the elastic
+    fleet through the CLI on a bursty trace — a cold scale-up during the
+    first burst, a scale-down drain + park in the lull, a WARM re-spawn
+    from the parked snapshot on the next wave, every request completing
+    its full budget — and the exported trace artifact validates with the
+    ("router","scale") lane present (the smoke exit-checks it)."""
+    import runner
+
+    from neuronx_distributed_tpu.observability import validate_chrome_trace
+
+    trace_path = tmp_path / "scale_trace.json"
+    runner.main(["serve", "--tiny", "--autoscale", "--max_batch", "2",
+                 "--num_requests", "14", "--max_new_tokens", "6",
+                 "--fused_steps", "3", "--min_replicas", "1",
+                 "--max_replicas", "2", "--mean_interarrival", "2.5",
+                 "--burst_every", "20", "--burst_mult", "4",
+                 "--scale_up_backlog", "0.5", "--scale_patience_blocks", "1",
+                 "--scale_down_util", "0.6", "--scale_down_idle_blocks", "3",
+                 "--scale_cooldown_blocks", "2",
+                 "--trace_out", str(trace_path)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 14
+    assert report["total_generated_tokens"] == 14 * 6
+    a = report["autoscale"]
+    assert a["scale_ups"] >= 2 and a["scale_downs"] >= 1
+    assert a["warm_spawns"] >= 1 and a["cold_spawns"] >= 1
+    assert a["time_to_ready_blocks_mean"] is not None
+    assert a["last_spawn_ms"] is not None
+    assert report["replica_blocks"] > 0
+    acts = [e["action"] for e in a["scale_events"]]
+    assert "up" in acts and "down" in acts and "parked" in acts
+    doc = json.loads(trace_path.read_text())
+    summary = validate_chrome_trace(doc)
+    assert {"scale_up", "scale_down", "scale_parked", "replicas_active"} \
+        <= summary["names"]
+
+
 def test_inference_runner_serve_trace_and_metrics_out(capsys, tmp_path):
     """ISSUE 6 CI gate: runner.py serve --trace_out/--metrics_out writes
     BOTH observability artifacts — the trace loads as valid Chrome

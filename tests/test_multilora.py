@@ -341,3 +341,49 @@ def test_router_drain_migrates_adapter_pins(lm, adapters):
     solo = eng.submit(P[1], 6, adapter="a0", request_id=rB)
     solo_comps = {c.request_id: c for c in eng.run()}
     assert comps[rB].tokens.tolist() == solo_comps[solo].tokens.tolist()
+
+
+def test_radix_prefix_reuse_is_adapter_namespaced(lm_paged, adapters):
+    """ISSUE 12 regression pin: a prefix's KV is a function of (tokens,
+    adapter) — before the namespaced radix, a page-aligned prefix built by
+    BASE-model traffic was silently reused for an adapter-pinned request
+    (and across adapters), serving wrong tokens. Now: cross-adapter
+    admissions on the same prompt prefix never match (each re-prefills and
+    streams exactly like its solo run), while SAME-adapter traffic keeps
+    full radix reuse (the prefix-hit economics survive the fix)."""
+    prefix = _prompts(1, s=12, seed=31)[0]
+    tails = _prompts(3, s=4, seed=33)
+
+    def solo(adapter, rid, tail):
+        eng = ServeEngine(lm_paged, block_steps=K, rng=jax.random.key(7))
+        _register(eng, adapters)
+        eng.submit(np.concatenate([prefix, tail]), 6, adapter=adapter,
+                   request_id=rid)
+        comps = eng.run()
+        return comps[0].tokens.tolist()
+
+    eng = ServeEngine(lm_paged, block_steps=K, rng=jax.random.key(7))
+    _register(eng, adapters)
+    pkv = eng.session.paged
+    # 1) base-model request plants the prefix path
+    r0 = eng.submit(np.concatenate([prefix, tails[0]]), 6)
+    eng.run()
+    assert pkv.stats["prefix_hits"] == 0
+    # 2) a0 on the SAME prefix: must NOT hit the base-model path — and the
+    # stream equals a0's solo run on a cold engine
+    r1 = eng.submit(np.concatenate([prefix, tails[1]]), 6, adapter="a0")
+    comps = {c.request_id: c for c in eng.completed + eng.run()}
+    assert pkv.stats["prefix_hits"] == 0, \
+        "cross-adapter prefix reuse would serve wrong tokens"
+    assert comps[r1].tokens.tolist() == solo("a0", r1, tails[1])
+    # 3) a0 AGAIN: same-namespace reuse works (hit), stream still exact
+    r2 = eng.submit(np.concatenate([prefix, tails[2]]), 6, adapter="a0")
+    comps = {c.request_id: c for c in eng.completed + eng.run()}
+    assert pkv.stats["prefix_hits"] == 1
+    assert pkv.stats["prefix_hit_tokens"] > 0
+    assert comps[r2].tokens.tolist() == solo("a0", r2, tails[2])
+    # the affinity probe answers per namespace too
+    full = np.concatenate([prefix, tails[2]]).tolist()
+    assert pkv.prefix_peek(full, ns="a0") > 0
+    assert pkv.prefix_peek(full, ns="a1") == 0
+    assert pkv.prefix_peek(full) > 0      # the base path is still cached

@@ -163,9 +163,32 @@ def build_model(args):
         paged_kw.update(
             lora_rank=args.adapter_rank,
             lora_slots=args.adapter_pool_slots or args.adapters + 1)
+    if getattr(args, "grammar_frac", 0.0) > 0:
+        # structured decoding: the grammar pool's (states, vocab) mask/next
+        # tables ride the fused scan as inputs; --grammar_pool_slots caps
+        # device residency (identity slot included) with LRU churn beyond
+        if args.cmd != "serve":
+            raise SystemExit("--grammar_frac applies to the serve "
+                             "subcommand")
+        paged_kw.update(
+            grammar_slots=(args.grammar_pool_slots
+                           or args.grammars + 1),
+            grammar_states=args.grammar_states)
     lm = CausalLM(cfg, params, _model_cls(args),
                   buckets=buckets, max_batch=args.max_batch, **paged_kw)
     return lm, cfg
+
+
+# the runner's demo grammar menu (serve --grammar_frac): g0 a bounded
+# integer, g1 a compact JSON object (lowered from a schema), g2 a
+# function-call shape — cycled over the constrained share of the trace
+DEMO_GRAMMARS = (
+    {"regex": "-?[0-9]{1,8}"},
+    {"json_schema": {"type": "object", "properties": {
+        "name": {"type": "string"}, "count": {"type": "integer"},
+        "ok": {"type": "boolean"}}}},
+    {"regex": '(get|set)\\("[a-z]{1,12}"\\)'},
+)
 
 
 def cmd_generate(args) -> None:
@@ -441,6 +464,14 @@ def cmd_serve(args) -> None:
     adapter_reg = None
     if args.adapters:
         adapter_reg, adapter_cfg = make_adapters()
+    # structured decoding: n demo grammars (regex + JSON-schema) cycled
+    # over --grammar_frac of the trace; admission pins each request's
+    # token-DFA tables in the device-resident pool (LRU churn past
+    # --grammar_pool_slots), the fused scan enforces the mask per step
+    grammar_reg = None
+    if getattr(args, "grammar_frac", 0.0) > 0:
+        grammar_reg = {f"g{i}": DEMO_GRAMMARS[i % len(DEMO_GRAMMARS)]
+                       for i in range(args.grammars)}
     # host-memory KV tier (paged + prefix cache only): sized in pages from
     # --host_tier_bytes via the per-page KV footprint; 0 = auto at 2x the
     # device pool (pool pressure then spills instead of shedding)
@@ -525,6 +556,7 @@ def cmd_serve(args) -> None:
             adapters=(None if adapter_reg is None else
                       {n: (ad, adapter_cfg)
                        for n, ad in adapter_reg.items()}),
+            grammars=grammar_reg,
             **eng_kw)
         completions = engine.run()
         export_observability(engine)
@@ -553,6 +585,8 @@ def cmd_serve(args) -> None:
         tenant_skew=args.tenant_skew,
         adapters=args.adapters,
         adapter_skew=args.adapter_skew,
+        grammar_frac=args.grammar_frac,
+        grammars=tuple(grammar_reg) if grammar_reg else (),
         diurnal=args.diurnal,
         diurnal_period_blocks=args.diurnal_period_blocks,
         burst_every=args.burst_every,
@@ -596,6 +630,9 @@ def cmd_serve(args) -> None:
                 rng=jax.random.key(args.seed), crash_at=crash_at,
                 autoscaler=autoscaler,
                 faults=resolve_fault_plan(args.fault_plan), **eng_kw)
+            if grammar_reg:
+                for n, spec in grammar_reg.items():
+                    router.register_grammar(n, **spec)
             report = run_disagg_trace(router, trace)
         else:
             # an autoscaled fleet STARTS at the policy floor and grows on
@@ -608,6 +645,9 @@ def cmd_serve(args) -> None:
             if adapter_reg:
                 for n, ad in adapter_reg.items():
                     router.register_adapter(n, ad, adapter_cfg)
+            if grammar_reg:
+                for n, spec in grammar_reg.items():
+                    router.register_grammar(n, **spec)
             report = run_router_trace(router, trace)
         if args.trace_out:
             router.tracer.export_chrome(args.trace_out)
@@ -629,6 +669,9 @@ def cmd_serve(args) -> None:
     if adapter_reg:
         for n, ad in adapter_reg.items():
             engine.register_adapter(n, ad, adapter_cfg)
+    if grammar_reg:
+        for n, spec in grammar_reg.items():
+            engine.register_grammar(n, **spec)
     # warm every program the trace will hit (all insert widths per bucket +
     # the fused block) OUTSIDE the timed window — cmd_generate's discipline.
     # Paged mode compiles its insert programs lazily per suffix width; the
@@ -955,6 +998,24 @@ def main(argv=None) -> None:
         p.add_argument("--adapter_skew", type=float, default=1.0,
                        help="serve --adapters: Zipf exponent of adapter "
                             "popularity (a0 the heavy hitter; 0 = uniform)")
+        p.add_argument("--grammar_frac", type=float, default=0.0,
+                       help="serve: label this fraction of trace requests "
+                            "with demo grammars (regex + JSON-schema, "
+                            "cycled) — structured decoding enforced inside "
+                            "the fused scan as a per-slot token-DFA mask; "
+                            "constrained output always parses")
+        p.add_argument("--grammars", type=int, default=3,
+                       help="serve --grammar_frac: how many demo grammars "
+                            "to register (g0..gN-1, cycling the demo menu)")
+        p.add_argument("--grammar_pool_slots", type=int, default=0,
+                       help="serve --grammar_frac: device-resident grammar "
+                            "pool slots incl. the identity slot (0 = "
+                            "grammars+1, i.e. no churn; smaller forces LRU "
+                            "load/evict churn of the mask tables)")
+        p.add_argument("--grammar_states", type=int, default=96,
+                       help="serve --grammar_frac: padded DFA-state "
+                            "capacity per pool slot (mask table is "
+                            "states x vocab per slot)")
         p.add_argument("--crash_replica_at", type=int, default=None,
                        help="serve --replicas: crash the last replica at "
                             "this router block — its streams fail over to "

@@ -23,6 +23,17 @@ from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     synthetic_trace,
     synthetic_trace_stream,
 )
+from neuronx_distributed_tpu.inference.grammar import (  # noqa: F401
+    CompiledGrammar,
+    GrammarCompileError,
+    GrammarLoadError,
+    GrammarPool,
+    GrammarPoolExhausted,
+    compile_token_dfa,
+    default_token_table,
+    detokenize,
+    json_schema_to_regex,
+)
 from neuronx_distributed_tpu.inference.faults import (  # noqa: F401
     DispatchFailed,
     FaultInjector,

@@ -158,6 +158,10 @@ class DecodeSession:
     # adapter pool (inference/adapters.py) — per SESSION, like the paged
     # pool, so router replicas sharing one lm keep independent residency
     adapters: Optional[Any] = None
+    # structured-decoding mode (grammar_slots set): the session's
+    # device-resident grammar pool (inference/grammar.py) — per SESSION,
+    # same residency economics as the adapter pool
+    grammars: Optional[Any] = None
 
 
 class CausalLM:
@@ -179,6 +183,9 @@ class CausalLM:
         lora_rank: Optional[int] = None,
         lora_slots: int = 0,
         lora_targets: Optional[Tuple[str, ...]] = None,
+        grammar_slots: int = 0,
+        grammar_states: int = 64,
+        grammar_tokens: Optional[Sequence[str]] = None,
     ):
         # keep the caller's use_flash_attention: prefill buckets >= 128 run
         # the Pallas kernel with position masks (reference prefill gating,
@@ -218,8 +225,39 @@ class CausalLM:
             if lora_targets:
                 over["lora_targets"] = tuple(lora_targets)
             self.config = dataclasses.replace(self.config, **over)
+        # structured decoding (inference/grammar.py): grammar tables never
+        # touch the model/config — they feed the SAMPLER inside the fused
+        # session scan, so only compile_session_decode_fused grows the
+        # trailing (*gr) tail (pool tables + per-slot grammar_idx / DFA
+        # state / token budget). Tables are program INPUTS: grammar
+        # loads/evicts change VALUES only — zero recompiles per mix.
+        self.grammar = bool(grammar_slots)
+        if self.grammar:
+            if grammar_slots < 2:
+                raise ValueError(
+                    f"grammar_slots must be >= 2 (slot 0 is the identity "
+                    f"grammar), got {grammar_slots}")
+            if grammar_states < 2:
+                raise ValueError(
+                    f"grammar_states must be >= 2, got {grammar_states}")
+        self.grammar_slots = int(grammar_slots)
+        self.grammar_states = int(grammar_states)
+        self.grammar_tokens: Optional[Tuple[str, ...]] = None
+        if self.grammar:
+            if grammar_tokens is None:
+                from neuronx_distributed_tpu.inference.grammar import (
+                    default_token_table,
+                )
+
+                grammar_tokens = default_token_table(config.vocab_size)
+            if len(grammar_tokens) != config.vocab_size:
+                raise ValueError(
+                    f"grammar_tokens has {len(grammar_tokens)} entries for "
+                    f"vocab_size {config.vocab_size}")
+            self.grammar_tokens = tuple(grammar_tokens)
         self._adapter_avals_cache: Optional[PyTree] = None
         self._identity_adapters_cache: Optional[PyTree] = None
+        self._identity_grammars_cache: Optional[PyTree] = None
         self.params = params
         self.max_batch = max_batch
         # applied INSIDE every compiled program (e.g. int8 dequantization —
@@ -366,6 +404,91 @@ class CausalLM:
             return ()
         tree = pool.tree if pool is not None else self._identity_adapters()
         return (tree, jnp.asarray(np.asarray(idx, np.int32)))
+
+    # --- structured-decoding plumbing ------------------------------------
+    # Grammar-enabled session programs take a trailing ``*gr`` quad —
+    # (tables tree, grammar_idx (b,), dfa_state (b,), token_budget (b,)) —
+    # threaded like the ``*ad`` pair so every builder/call site stays
+    # byte-identical when grammars are off. Only the fused session scan
+    # consumes it: enforcement is a per-step mask on the SAMPLER, never a
+    # model change. The first-token sample (insert/chunk-finish/replay) and
+    # the stepwise oracle apply the same mask host-side via the engine.
+
+    def new_grammar_pool(self):
+        """Fresh device-resident grammar pool (slot 0 = accept-everything
+        identity) sized by (grammar_slots, grammar_states) over this lm's
+        token table — one per session."""
+        from neuronx_distributed_tpu.inference.grammar import GrammarPool
+
+        if not self.grammar:
+            raise ValueError("CausalLM was built without grammar_slots")
+        return GrammarPool(self.grammar_slots, self.grammar_states,
+                           self.grammar_tokens)
+
+    def _identity_grammars(self) -> Dict[str, jax.Array]:
+        """All-identity table stack (every row unconstrained) — what
+        pool-less dispatches feed grammar-enabled programs."""
+        if self._identity_grammars_cache is None:
+            from neuronx_distributed_tpu.inference.grammar import _INF
+
+            G, S = self.grammar_slots, self.grammar_states
+            V = self.config.vocab_size
+            self._identity_grammars_cache = {
+                "need": jnp.concatenate(
+                    [jnp.zeros((1, S, V), jnp.int32),
+                     jnp.full((G - 1, S, V), _INF, jnp.int32)]),
+                "next": jnp.zeros((G, S, V), jnp.int32),
+                "terminal": jnp.zeros((G, S), bool),
+            }
+        return self._identity_grammars_cache
+
+    def _gr_lower(self, rows: int) -> tuple:
+        """Trailing lowering avals for grammar-enabled session programs:
+        the table-stack avals plus (rows,) idx/state/budget vectors — ()
+        when grammars are off."""
+        if not self.grammar:
+            return ()
+        G, S = self.grammar_slots, self.grammar_states
+        V = self.config.vocab_size
+        tree = {
+            "need": jax.ShapeDtypeStruct((G, S, V), jnp.int32),
+            "next": jax.ShapeDtypeStruct((G, S, V), jnp.int32),
+            "terminal": jax.ShapeDtypeStruct((G, S), jnp.bool_),
+        }
+        return (tree,
+                jax.ShapeDtypeStruct((rows,), jnp.int32),
+                jax.ShapeDtypeStruct((rows,), jnp.int32),
+                jax.ShapeDtypeStruct((rows,), jnp.int32))
+
+    def _gr_args(self, pool, gidx, gstate, gbudget) -> tuple:
+        """Trailing call args: the pool's live tables (identity when no
+        pool rides along) + per-row grammar slot / DFA state / budget — ()
+        when grammars are off."""
+        if not self.grammar:
+            return ()
+        tree = pool.tree if pool is not None else self._identity_grammars()
+        return (tree,
+                jnp.asarray(np.asarray(gidx, np.int32)),
+                jnp.asarray(np.asarray(gstate, np.int32)),
+                jnp.asarray(np.asarray(gbudget, np.int32)))
+
+    @staticmethod
+    def grammar_allowed(tree, gidx, gstate, gbudget, counts):
+        """The (b, vocab) budget-aware allowed mask — THE structured-
+        decoding enforcement boolean, used identically by the fused scan
+        (device tables, inside the program) and the engine's host-side
+        sampling sites (first token, stepwise oracle). ``need[s, v]`` is
+        the budget a transition still requires after taking it (INF =
+        forbidden), so the mask is ONE row gather plus two compares:
+        ``need ≤ budget − counts − 1``, falling back to the plain
+        reachability mask (``need < INF``) when the budget-aware set
+        empties (only frozen rows), with identity rows (grammar_idx 0)
+        all-True via slot 0's all-zeros need."""
+        need = tree["need"][gidx, gstate]                 # (b, V)
+        remaining = (gbudget - counts - 1)[:, None]
+        ok = need <= remaining
+        fb = need < jnp.int32(2 ** 30)
+        return jnp.where(ok.any(axis=-1, keepdims=True), ok, fb)
 
     def compile(self) -> "CausalLM":
         # every cache a program RETURNS is pinned replicated (_replicate_out,
@@ -546,11 +669,23 @@ class CausalLM:
         ``counts`` exactly from the single per-block fetch — one program
         call + one fetch per K tokens for the whole pool.
 
+        Structured decoding (lm built with ``grammar_slots``): the program
+        grows a trailing ``(grammar tables, grammar_idx (b,), dfa_state
+        (b,), token_budget (b,))`` quad. Each step gathers the current
+        state's allowed-mask/next-state rows (budget-aware — see
+        :meth:`grammar_allowed`), the sampler floors disallowed logits to
+        −1e30 before greedy/categorical selection, the emitted token drives
+        a next-state gather carried through the scan, and entering an
+        accept-terminal state latches ``done`` exactly like EOS. Identity
+        rows (idx 0) see an all-ones mask — their logits are bit-for-bit
+        untouched — and the tables ride the dispatch as inputs: zero extra
+        host ops, zero recompiles when the grammar mix changes.
+
         Returns the compiled program ``(params, cache, tok (b,1), slot_keys
         (b,) keys, counts (b,), lengths (b,), active (b,), done (b,),
-        eos_ids (b,), temperature (b,), greedy (b,)) -> (tokens (steps, b),
-        cache, next_tok, lengths, done)``. Cached per ``(steps,
-        slot_sampler, pad)``.
+        eos_ids (b,), temperature (b,), greedy (b,)[, *gr]) -> (tokens
+        (steps, b), cache, next_tok, lengths, done)``. Cached per
+        ``(steps, slot_sampler, pad)``.
         """
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
@@ -559,25 +694,54 @@ class CausalLM:
         if key in self._session_fused:
             return self._session_fused[key]
         max_len = self.config.max_seq_len
+        n_ad = 2 if self.lora else 0
 
         def fused_fn(params, cache, tok, slot_keys, counts, lengths, active,
-                     done, eos_ids, temperature, greedy, *ad):
+                     done, eos_ids, temperature, greedy, *tail):
+            ad = tail[:n_ad]
+            gr = tail[n_ad:]
+            if gr:
+                gtree, gidx, gstate0, gbudget = gr
+                gactive = gidx > 0
+
             def body(carry, _):
-                cache, tok, counts, lengths, done = carry
+                if gr:
+                    cache, tok, counts, lengths, done, gstate = carry
+                else:
+                    cache, tok, counts, lengths, done = carry
                 sub = jax.vmap(jax.random.fold_in)(slot_keys, counts)
                 logits, mut = self.model.apply(
                     self._ad_vars(params, cache, ad), tok, mutable=["cache"]
                 )
-                nxt = slot_sampler(logits[:, 0, :], sub, temperature, greedy)
+                allowed = None
+                if gr:
+                    allowed = self.grammar_allowed(
+                        gtree, gidx, gstate, gbudget, counts)
+                nxt = slot_sampler(logits[:, 0, :], sub, temperature, greedy,
+                                   allowed=allowed)
+                done_before = done
                 out = jnp.where(done | ~active, jnp.int32(pad_token_id), nxt)
                 done = done | (active & (eos_ids >= 0) & (nxt == eos_ids))
+                if gr:
+                    # frozen rows keep their state; live grammar rows step
+                    # to next[state, emitted] and latch done on an
+                    # accept-terminal landing (the grammar's EOS)
+                    adv = gactive & active & ~done_before
+                    new_state = gtree["next"][gidx, gstate, nxt]
+                    gstate = jnp.where(adv, new_state, gstate)
+                    done = done | (adv & gtree["terminal"][gidx, gstate])
                 counts = counts + 1
                 lengths = lengths + 1
                 done = done | (active & (lengths + 1 >= max_len))
-                return (mut["cache"], nxt[:, None], counts, lengths, done), out
+                carry = ((mut["cache"], nxt[:, None], counts, lengths, done,
+                          gstate) if gr else
+                         (mut["cache"], nxt[:, None], counts, lengths, done))
+                return carry, out
 
-            (cache, tok, counts, lengths, done), toks = jax.lax.scan(
-                body, (cache, tok, counts, lengths, done), None, length=steps)
+            init = ((cache, tok, counts, lengths, done, gstate0) if gr
+                    else (cache, tok, counts, lengths, done))
+            carry, toks = jax.lax.scan(body, init, None, length=steps)
+            cache, tok, _counts, lengths, done = carry[:5]
             return toks, self._replicate_out(cache), tok, lengths, done
 
         b = self.max_batch
@@ -591,7 +755,7 @@ class CausalLM:
                    jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
                    jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
                    jnp.ones((b,), jnp.float32), jnp.ones((b,), bool),
-                   *self._ad_lower(b))
+                   *self._ad_lower(b), *self._gr_lower(b))
             .compile())
         return self._session_fused[key]
 
@@ -660,6 +824,8 @@ class CausalLM:
                                               session.paged.tables)
         if self.lora:
             session.adapters = self.new_adapter_pool()
+        if self.grammar:
+            session.grammars = self.new_grammar_pool()
         return session
 
     def _check_slots(self, slot_ids: np.ndarray) -> None:

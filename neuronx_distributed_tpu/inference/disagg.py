@@ -224,6 +224,11 @@ class DisaggRouter(Router):
                 "multi-LoRA disaggregation is not supported yet — the "
                 "adopted KV is adapter-specific and the pin would have to "
                 "migrate with the pages (lands with the TP-sharding arc)")
+        # grammars DO disaggregate: the token DFA rides the SAMPLER, not
+        # the KV — the prefill side constrains the first token and
+        # releases its pin at handoff; the adopting decode worker re-pins
+        # the (fleet-registered) grammar and walks the delivered token to
+        # restore the DFA state (ServeEngine.adopt_handoff)
         return super().submit(prompt, max_new_tokens, **kw)
 
     def _viable_replicas(self, e: _Entry) -> List[int]:

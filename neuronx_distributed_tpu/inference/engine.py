@@ -122,6 +122,10 @@ from neuronx_distributed_tpu.inference.causal_lm import (
     _set_block_tables,
     _set_cache_index_rows,
 )
+from neuronx_distributed_tpu.inference.grammar import (
+    GrammarLoadError,
+    GrammarPoolExhausted,
+)
 from neuronx_distributed_tpu.inference.faults import (
     DispatchFailed,
     FaultInjector,
@@ -163,6 +167,11 @@ class Request:
     # tokens must be sampled under (None = the base model / identity slot).
     # Admission loads+pins it in the session's AdapterPool; retire unpins.
     adapter: Optional[str] = None
+    # structured decoding: name of the registered grammar this request's
+    # stream must match (None = free-form / identity slot 0). Admission
+    # loads+pins its token-DFA tables in the session's GrammarPool; the
+    # fused scan enforces the mask per step; retire unpins.
+    grammar: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -187,6 +196,13 @@ class Completion:
     deadline_missed: bool = False
     tenant: str = "default"
     adapter: Optional[str] = None
+    grammar: Optional[str] = None
+    # why the stream ended (ISSUE 13 satellite — callers previously had to
+    # DIFF fields to infer this): "eos" (sampled its eos id), "budget"
+    # (max_new_tokens exhausted), "expired" (deadline cut it off),
+    # "grammar_accept" (the token DFA entered an accept-terminal state —
+    # the structured-decoding EOS), or "cancelled"
+    finish_reason: str = "budget"
 
 
 @dataclasses.dataclass
@@ -263,6 +279,7 @@ _STAT_KEYS = (
     "dispatch_retries", "corrupt_page_replays", "restored_requests",
     "tier_page_repairs",
     "adapter_rejects", "adapter_load_retries",
+    "grammar_rejects", "grammar_load_retries",
     "handoffs_sent", "handoffs_adopted",
 )
 
@@ -558,6 +575,26 @@ class ServeEngine:
             if self._injector is not None:
                 self.session.adapters.fault_hook = \
                     self._injector.on_adapter_acquire
+        # structured-decoding mode (lm built with grammar_slots): admission
+        # loads+pins the request's token-DFA tables in the session's
+        # GrammarPool; the per-slot grammar_idx/dfa_state/token_budget
+        # arrays ride every fused dispatch next to eos/temperature, and the
+        # host mirrors the DFA walk from the fetched emissions (a pure
+        # function of the emitted tokens — no extra host ops).
+        self.grammar = bool(getattr(lm, "grammar", False))
+        self._gidx = np.zeros((b,), np.int32)
+        self._gstate = np.zeros((b,), np.int32)
+        self._gbudget = np.zeros((b,), np.int32)
+        self._grammar_pins: Dict[int, str] = {}
+        # finish_reason latches, keyed by request id ("eos" / "budget" /
+        # "grammar_accept"); expiry/cancel override at completion time
+        self._finish_reason: Dict[int, str] = {}
+        if self.grammar:
+            self.session.grammars.attach_observability(
+                self.tracer, self.metrics, block_fn=lambda: self.blocks)
+            if self._injector is not None:
+                self.session.grammars.fault_hook = \
+                    self._injector.on_grammar_acquire
         # legacy counter surface, now a registry-backed view (see _StatsView)
         self.stats = _StatsView(self.metrics, _STAT_KEYS)
 
@@ -582,6 +619,41 @@ class ServeEngine:
         if not self.session.adapters.registered(adapter):
             raise ValueError(
                 f"unknown adapter {adapter!r} (register_adapter first)")
+
+    def register_grammar(self, name: str, regex: Optional[str] = None,
+                         json_schema: Optional[dict] = None) -> None:
+        """Compile + register a grammar with the session's device-resident
+        pool (host-side only — tables become device-resident at the first
+        admission that pins them, ``submit(grammar=name)``). Raises
+        :class:`~neuronx_distributed_tpu.inference.grammar.
+        GrammarCompileError` on a bad pattern — rejection happens HERE (or
+        at submit for budget/unknown-name errors), never after device
+        work started."""
+        if not self.grammar:
+            raise ValueError(
+                "register_grammar requires a CausalLM built with "
+                "grammar_slots")
+        self.session.grammars.register(name, regex=regex,
+                                       json_schema=json_schema)
+
+    def _validate_grammar(self, grammar: Optional[str],
+                          max_new_tokens: int) -> None:
+        if grammar is None:
+            return
+        if not self.grammar:
+            raise ValueError(
+                "submit(grammar=) requires a CausalLM built with "
+                "grammar_slots")
+        pool = self.session.grammars
+        if not pool.registered(grammar):
+            raise ValueError(
+                f"unknown grammar {grammar!r} (register_grammar first)")
+        need = pool.min_tokens(grammar)
+        if max_new_tokens < need:
+            raise ValueError(
+                f"grammar {grammar!r} needs at least {need} tokens to reach "
+                f"an accept state; max_new_tokens {max_new_tokens} could "
+                f"never parse")
 
     def _validate_submit(self, prompt: np.ndarray, max_new_tokens: int,
                          sampler: Optional[Sampler]
@@ -636,6 +708,7 @@ class ServeEngine:
                deadline_ms: Optional[float] = None,
                tenant: str = "default",
                adapter: Optional[str] = None,
+               grammar: Optional[str] = None,
                request_id: Optional[int] = None) -> Union[int, "Rejected"]:
         """Queue a request; returns its id — or, when the bounded queue
         sheds it at arrival, a structured :class:`Rejected` with a
@@ -658,6 +731,7 @@ class ServeEngine:
         prompt, sampler, greedy = self._validate_submit(
             prompt, max_new_tokens, sampler)
         self._validate_adapter(adapter)
+        self._validate_grammar(grammar, int(max_new_tokens))
         rid = self._next_id if request_id is None else int(request_id)
         req = Request(
             request_id=rid, prompt=prompt,
@@ -671,6 +745,7 @@ class ServeEngine:
                 arrival_block, deadline_ms, "deadline_ms"),
             tenant=str(tenant),
             adapter=adapter,
+            grammar=grammar,
         )
         return self.submit_request(req)
 
@@ -696,6 +771,7 @@ class ServeEngine:
                       "deadline_block": req.deadline_block,
                       "tenant": req.tenant,
                       "adapter": req.adapter,
+                      "grammar": req.grammar,
                       "engine": self.lane})
         # bound the ARRIVED backlog at submit time (the live-client path);
         # future-arrival submissions are scheduled arrivals, not queue
@@ -729,6 +805,7 @@ class ServeEngine:
             if r.request_id == request_id:
                 del self.queue[i]
                 self._release_adapter(r)
+                self._release_grammar(r)
                 self.stats["cancelled"] += 1
                 if self.tracer.enabled:
                     self.tracer.instant("cancel", ("req", request_id),
@@ -750,6 +827,7 @@ class ServeEngine:
             if st.req.request_id == request_id:
                 self._abort_prefill(slot, requeue=False)
                 self._release_adapter(st.req)
+                self._release_grammar(st.req)
                 self.stats["cancelled"] += 1
                 if self.tracer.enabled:
                     self.tracer.instant("cancel", ("req", request_id),
@@ -839,6 +917,137 @@ class ServeEngine:
         name = self._adapter_pins.pop(req.request_id, None)
         if name is not None:
             self.session.adapters.release(name)
+
+    # --- grammar admission (structured decoding) -------------------------
+
+    def _acquire_grammar(self, req: Request) -> bool:
+        """Load + pin the request's grammar tables at admission time (no-op
+        for free-form requests, or when a requeued admission's pin
+        survived) — the ``_acquire_adapter`` contract: False means the
+        request did NOT admit this round (shed with
+        ``Rejected(reason="grammar_pool_exhausted")``, or requeued on an
+        injected :class:`GrammarLoadError`)."""
+        if req.grammar is None or not self.grammar:
+            return True
+        if req.request_id in self._grammar_pins:
+            return True
+        pool = self.session.grammars
+        loads_before = pool.stats["loads"]
+        try:
+            slot = pool.acquire(req.grammar)
+        except GrammarPoolExhausted:
+            rej = Rejected(
+                request_id=req.request_id,
+                retry_after_blocks=self._pool_retry_after(),
+                queue_depth=sum(1 for r in self.queue
+                                if r.arrival_block <= self.blocks),
+                reason="grammar_pool_exhausted")
+            self.rejected.append(rej)
+            self.stats["rejected"] += 1
+            self.stats["grammar_rejects"] += 1
+            self._release_adapter(req)   # the group-mate pin goes too
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "shed", ("req", req.request_id), block=self.blocks,
+                    args={"reason": rej.reason, "grammar": req.grammar,
+                          "retry_after_blocks": rej.retry_after_blocks})
+            return False
+        except GrammarLoadError as e:
+            self.stats["grammar_load_retries"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "grammar_defer", ("req", req.request_id),
+                    block=self.blocks,
+                    args={"grammar": req.grammar, "error": str(e)})
+            self.queue.appendleft(req)
+            return False
+        self._grammar_pins[req.request_id] = req.grammar
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "grammar_load", ("req", req.request_id), block=self.blocks,
+                args={"grammar": req.grammar, "slot": int(slot),
+                      "cold": pool.stats["loads"] > loads_before})
+        return True
+
+    def _grammar_slot(self, req: Request) -> int:
+        if req.grammar is None or not self.grammar:
+            return 0
+        return self.session.grammars.slot_of(req.grammar)
+
+    def _release_grammar(self, req: Request) -> None:
+        name = self._grammar_pins.pop(req.request_id, None)
+        if name is not None:
+            self.session.grammars.release(name)
+
+    def _grammar_walk(self, name: str, state: int,
+                      tokens: Sequence[int]) -> int:
+        """Host-side DFA walk (registry tables) — the replay/adoption path
+        restoring a resumed stream's state from its delivered tokens."""
+        dfa = self.session.grammars.grammar(name)
+        for t in tokens:
+            state = dfa.walk(state, int(t))
+            if state < 0:
+                raise ValueError(
+                    f"delivered token {int(t)} violates grammar {name!r} — "
+                    f"the recovery record is corrupt")
+        return state
+
+    def _advance_grammar(self, slot: int, token: int) -> None:
+        """Mirror the device's DFA transition for one EMITTED token of a
+        live grammar slot: step the host state, and latch ``done`` (+
+        ``finish_reason="grammar_accept"``) on an accept-terminal landing
+        — the grammar's EOS. A pure function of the fetched emissions, so
+        the mirror costs no extra host ops."""
+        if not self.grammar or self._gidx[slot] == 0:
+            return
+        req = self.slots[slot]
+        if req is None:
+            return
+        dfa = self.session.grammars.grammar(req.grammar)
+        nxt = dfa.walk(int(self._gstate[slot]), int(token))
+        if nxt < 0:
+            # unreachable for active rows (the mask forbids it); keep the
+            # frozen state for done rows whose raw sample wandered
+            return
+        self._gstate[slot] = nxt
+        if dfa.terminal[nxt]:
+            self._done[slot] = True
+            if self._finish_reason.get(req.request_id) != "eos":
+                self._finish_reason[req.request_id] = "grammar_accept"
+
+    def _grammar_allowed_rows(self, reqs: Sequence[Request],
+                              states: Sequence[int],
+                              counts: Sequence[int]):
+        """Host-side (rows, vocab) budget-aware allowed mask for a
+        first-token sampling site — None when no row is constrained (the
+        sampler path stays byte-identical to a grammarless engine). The
+        boolean math is :meth:`CausalLM.grammar_allowed` run on the host
+        registry tables, so host and device masks agree exactly."""
+        if not self.grammar or all(r.grammar is None for r in reqs):
+            return None
+        pool = self.session.grammars
+        rows = []
+        for r, st, ct in zip(reqs, states, counts):
+            if r.grammar is None:
+                rows.append(np.ones((pool.vocab,), bool))
+            else:
+                dfa = pool.grammar(r.grammar)
+                rows.append(dfa.allowed_row(
+                    int(st), int(r.max_new_tokens) - int(ct) - 1))
+        return np.stack(rows)
+
+    @staticmethod
+    def _mask_logits(logits, allowed):
+        """Pre-mask first-token logits on the HOST (numpy) when a group
+        carries constrained rows: the sampler then runs its ordinary
+        unmasked path, so masked admissions add ZERO new eager-op shapes
+        over a grammarless engine (first-call eager compiles would
+        otherwise land inside measured serving windows). Bit-identical to
+        the in-sampler ``where``: both select the same float values."""
+        if allowed is None:
+            return logits
+        return jnp.asarray(np.where(
+            allowed, np.asarray(logits, np.float32), np.float32(-1e30)))
 
     # --- deadlines / shedding / dispatch (the fault-tolerance half) ------
 
@@ -988,6 +1197,7 @@ class ServeEngine:
             if self.incident is not None:
                 self._pool_pressure_blocks.append(self.blocks)
         self._release_adapter(victim)
+        self._release_grammar(victim)
         rej = Rejected(request_id=victim.request_id,
                        retry_after_blocks=retry,
                        queue_depth=sum(1 for r in self.queue
@@ -1027,6 +1237,7 @@ class ServeEngine:
                              key=lambda r: (r.arrival_block, r.request_id))
             self.queue.remove(victim)
             self._release_adapter(victim)
+            self._release_grammar(victim)
             self.rejected.append(Rejected(
                 request_id=victim.request_id,
                 retry_after_blocks=self._retry_after(),
@@ -1099,6 +1310,7 @@ class ServeEngine:
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
         self._release_adapter(req)   # retire unpins (adapter stays resident)
+        self._release_grammar(req)   # ... and the grammar pin likewise
         if self.incident is not None and (expired or self._missed(req)):
             self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
@@ -1108,6 +1320,11 @@ class ServeEngine:
                 kind, ("req", req.request_id), block=self.blocks,
                 args={"generated": len(self._out.get(req.request_id, [])),
                       "deadline_missed": bool(expired or self._missed(req))})
+        reason = self._finish_reason.pop(req.request_id, "budget")
+        if cancelled:
+            reason = "cancelled"
+        elif expired:
+            reason = "expired"
         return Completion(
             request_id=req.request_id,
             tokens=np.asarray(self._out.pop(req.request_id, []), np.int64),
@@ -1124,6 +1341,8 @@ class ServeEngine:
             deadline_missed=expired or self._missed(req),
             tenant=req.tenant,
             adapter=req.adapter,
+            grammar=req.grammar,
+            finish_reason=reason,
         )
 
     def _complete_slot(self, slot: int, cancelled: bool = False,
@@ -1135,6 +1354,8 @@ class ServeEngine:
         self._active[slot] = False
         self._done[slot] = False
         self._adapter_idx[slot] = 0
+        self._gidx[slot] = 0
+        self._gstate[slot] = 0
 
     def _trace_queued(self, req: Request, now: float) -> None:
         """Close the request's 'queued' lifecycle span (submit wall stamp ->
@@ -1175,6 +1396,7 @@ class ServeEngine:
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
         self._release_adapter(req)
+        self._release_grammar(req)
         if self.incident is not None:
             self._miss_blocks.append(self.blocks)
         if self.tracer.enabled:
@@ -1192,7 +1414,10 @@ class ServeEngine:
             expired=True, deadline_missed=True,
             tenant=req.tenant,
             adapter=req.adapter,
+            grammar=req.grammar,
+            finish_reason="expired",
         ))
+        self._finish_reason.pop(req.request_id, None)
         self.stats["expired"] += 1
 
     def _expire_queued(self) -> None:
@@ -1269,6 +1494,9 @@ class ServeEngine:
                 if not self._acquire_adapter(head):
                     deferred.add(head.request_id)
                     continue
+                if not self._acquire_grammar(head):
+                    deferred.add(head.request_id)
+                    continue
                 self._begin_chunked(head, free[0])
                 continue
             bucket = self.lm._bucket_for(head.prompt.size)
@@ -1286,7 +1514,7 @@ class ServeEngine:
             # groupmates still ride one right-sized insert
             admitted = []
             for r in group:
-                if self._acquire_adapter(r):
+                if self._acquire_adapter(r) and self._acquire_grammar(r):
                     admitted.append(r)
                 else:
                     deferred.add(r.request_id)
@@ -1380,6 +1608,13 @@ class ServeEngine:
         sub = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((rows,), jnp.int32))
         temps = np.asarray([r.temperature for r in group], np.float32)
         greedy = np.asarray([r.greedy for r in group], bool)
+        # first tokens are constrained too: budget-aware mask from each
+        # grammar's START state, pre-applied host-side (no-op when the
+        # whole group is free-form — the sampler call and its compiled
+        # eager shapes stay byte-identical to a grammarless engine)
+        logits = self._mask_logits(
+            logits, self._grammar_allowed_rows(group, [0] * rows,
+                                               [0] * rows))
         first = np.asarray(self.slot_sampler(
             logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))
         now = time.perf_counter()
@@ -1401,7 +1636,11 @@ class ServeEngine:
             self._slot_keys = self._slot_keys.at[slot].set(keys[i])
             self._gen_counts[slot] = 1
             self._adapter_idx[slot] = 0 if aslots is None else aslots[i]
+            self._gidx[slot] = self._grammar_slot(r)
+            self._gstate[slot] = 0
+            self._gbudget[slot] = r.max_new_tokens
             self._record(slot, int(first[i]), now)
+            self._advance_grammar(slot, int(first[i]))
         if self.role == "prefill":
             # disaggregation: the prompt's KV is done and its first token
             # sampled — hand the pages to the decode pool and free the slot
@@ -1508,6 +1747,8 @@ class ServeEngine:
                                            jnp.zeros((1,), jnp.int32))
         temps = np.asarray([req.temperature], np.float32)
         greedy = np.asarray([req.greedy], bool)
+        logits = self._mask_logits(
+            logits, self._grammar_allowed_rows([req], [0], [0]))
         first = int(np.asarray(self.slot_sampler(
             logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
         req.first_token_block = self.blocks
@@ -1524,7 +1765,11 @@ class ServeEngine:
         self._greedy[slot] = greedy[0]
         self._tok[slot] = first
         self._gen_counts[slot] = 1
+        self._gidx[slot] = self._grammar_slot(req)
+        self._gstate[slot] = 0
+        self._gbudget[slot] = req.max_new_tokens
         self._record(slot, first, time.perf_counter())
+        self._advance_grammar(slot, first)
         if self.role == "prefill":
             self._handoff_group([slot])
 
@@ -1581,7 +1826,7 @@ class ServeEngine:
                 self.stats["deferred_admissions"] += 1
                 self._note_pool_pressure(())
                 return
-            except AdapterPoolExhausted:
+            except (AdapterPoolExhausted, GrammarPoolExhausted):
                 # a replay is a stream the client is already consuming: it
                 # is never shed — it waits for a pin to return, exactly
                 # like pool pressure defers to the next block
@@ -1594,6 +1839,14 @@ class ServeEngine:
                         "adapter_defer", ("req", req.request_id),
                         block=self.blocks,
                         args={"adapter": req.adapter, "state": "replay"})
+                return
+            except GrammarLoadError:
+                self.stats["grammar_load_retries"] += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "grammar_defer", ("req", req.request_id),
+                        block=self.blocks,
+                        args={"grammar": req.grammar, "state": "replay"})
                 return
             self._replay_q.popleft()
 
@@ -1614,6 +1867,15 @@ class ServeEngine:
                 self.session.adapters.acquire(req.adapter)
                 self._adapter_pins[req.request_id] = req.adapter
             aslot = self.session.adapters.slot_of(req.adapter)
+        gslot = 0
+        if self.grammar and req.grammar is not None:
+            # re-pin the grammar tables before any page work (same
+            # discipline as the adapter pin above); exhaustion/load faults
+            # propagate to _drain_replays, which defers the replay
+            if req.request_id not in self._grammar_pins:
+                self.session.grammars.acquire(req.grammar)
+                self._grammar_pins[req.request_id] = req.grammar
+            gslot = self.session.grammars.slot_of(req.grammar)
         g = len(pregen)
         seq = (np.concatenate([req.prompt, np.asarray(pregen, np.int32)])
                if g else np.asarray(req.prompt, np.int32))
@@ -1665,6 +1927,14 @@ class ServeEngine:
                                            jnp.full((1,), g, jnp.int32))
         temps = np.asarray([req.temperature], np.float32)
         greedy = np.asarray([req.greedy], bool)
+        # resumed constrained stream: the DFA state is a pure function of
+        # the delivered tokens — walk them, then mask token g exactly as
+        # the uninterrupted run would have (snapshot/failover carries the
+        # grammar NAME; the state is recomputed, so it cannot drift)
+        rstate = (self._grammar_walk(req.grammar, 0, pregen)
+                  if self.grammar and req.grammar is not None else 0)
+        logits = self._mask_logits(
+            logits, self._grammar_allowed_rows([req], [rstate], [g]))
         tok = int(np.asarray(self.slot_sampler(
             logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
         now = time.perf_counter()
@@ -1686,6 +1956,9 @@ class ServeEngine:
         self._slot_keys = self._slot_keys.at[slot].set(key)
         self._gen_counts[slot] = g + 1
         self._adapter_idx[slot] = aslot
+        self._gidx[slot] = gslot
+        self._gstate[slot] = rstate
+        self._gbudget[slot] = req.max_new_tokens
         if g == 0:
             self._observe_first_token(req, slot, now, replayed=True)
         elif self.tracer.enabled:
@@ -1695,6 +1968,7 @@ class ServeEngine:
                 "replay_admit", ("req", req.request_id), block=self.blocks,
                 ts=now, args={"slot": int(slot), "resumed_at": int(g)})
         self._record(slot, tok, now)
+        self._advance_grammar(slot, tok)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
 
@@ -1931,6 +2205,10 @@ class ServeEngine:
             self.slots[slot] = None
             self._active[slot] = False
             self._done[slot] = False
+            # the pin moves with the stream: released here, re-taken by the
+            # adopting decode worker (the drain-migration discipline)
+            self._release_grammar(req)
+            self._gidx[slot] = 0
             self._out.pop(rid, None)
             self._out_ts.pop(rid, None)
             self._last_tok_ts.pop(rid, None)
@@ -1963,6 +2241,20 @@ class ServeEngine:
                     "migrate:corrupt", (self.lane, "migrate"),
                     block=self.blocks, args={"rid": req.request_id})
             return "degraded"
+        gslot = 0
+        if self.grammar and req.grammar is not None:
+            # pin the stream's grammar tables before any page work; pool
+            # pressure defers the adoption (the handoff survives at the
+            # router), a load fault retries next block — never a stream
+            # decoded without its mask
+            try:
+                if req.request_id not in self._grammar_pins:
+                    self.session.grammars.acquire(req.grammar)
+                    self._grammar_pins[req.request_id] = req.grammar
+            except (GrammarPoolExhausted, GrammarLoadError):
+                self.stats["deferred_admissions"] += 1
+                return "deferred"
+            gslot = self.session.grammars.slot_of(req.grammar)
         slot = free[0]
         pkv = self.session.paged
         t0 = time.perf_counter()
@@ -1999,6 +2291,12 @@ class ServeEngine:
         self._slot_keys = self._slot_keys.at[slot].set(self._req_key(rid))
         self._gen_counts[slot] = 1
         self._adapter_idx[slot] = 0
+        self._gidx[slot] = gslot
+        # the DFA already consumed the prefill-side first token
+        self._gstate[slot] = (
+            self._grammar_walk(req.grammar, 0, [int(h.first_token)])
+            if gslot else 0)
+        self._gbudget[slot] = req.max_new_tokens
         self.stats["handoffs_adopted"] += 1
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._m_handoff.observe(dt_ms)
@@ -2046,6 +2344,7 @@ class ServeEngine:
         self._m_queue.set(0)
         for r in out:
             self._release_adapter(r)   # the pin migrates with the request
+            self._release_grammar(r)
         return out
 
     def extract_prefilling(self) -> List[Request]:
@@ -2060,6 +2359,7 @@ class ServeEngine:
             out.append(req)
             self._abort_prefill(slot, requeue=False)
             self._release_adapter(req)
+            self._release_grammar(req)
         return out
 
     def extract_replays(self) -> List[Tuple[Request, List[int]]]:
@@ -2070,6 +2370,7 @@ class ServeEngine:
         self._replay_q.clear()
         for req, _gen in out:
             self._release_adapter(req)
+            self._release_grammar(req)
         return out
 
     def has_decode_work(self) -> bool:
@@ -2088,7 +2389,19 @@ class ServeEngine:
         :meth:`from_snapshot`; take it between blocks (``run`` does, via
         ``snapshot_path``)."""
         def enc(r: Request, state: str, generated: List[int]) -> dict:
+            # constrained streams carry (grammar name, DFA state): the
+            # state is recomputable from the generated tokens (and the
+            # restore path recomputes it — it can never drift), recorded
+            # here so a snapshot reader sees where the stream stood
+            gstate = None
+            if r.grammar is not None and self.grammar:
+                try:
+                    gstate = self._grammar_walk(r.grammar, 0, generated)
+                except (KeyError, ValueError):
+                    gstate = None
             return {
+                "grammar": r.grammar,
+                "grammar_state": gstate,
                 "request_id": int(r.request_id),
                 "prompt": [int(t) for t in r.prompt],
                 "max_new_tokens": int(r.max_new_tokens),
@@ -2161,6 +2474,7 @@ class ServeEngine:
     @classmethod
     def from_snapshot(cls, lm: CausalLM, snap: Union[dict, str],
                       adapters: Optional[dict] = None,
+                      grammars: Optional[dict] = None,
                       **overrides) -> "ServeEngine":
         """Rebuild an engine from a :meth:`snapshot` (dict or file path) on
         a fresh session: queued requests re-enter the queue with their
@@ -2190,6 +2504,13 @@ class ServeEngine:
         if adapters:
             for name, (lp, lc) in adapters.items():
                 eng.register_adapter(name, lp, lc)
+        # grammar TABLES are not snapshotted either (compilation is
+        # deterministic): ``grammars`` re-registers {name: {"regex": ...} |
+        # {"json_schema": ...}} so constrained replays re-pin and the walk
+        # restores each stream's DFA state from its delivered tokens
+        if grammars:
+            for name, spec in grammars.items():
+                eng.register_grammar(name, **spec)
         eng.blocks = int(snap["blocks"])
         eng._next_id = int(snap["next_id"])
         for rd in snap["requests"]:
@@ -2206,6 +2527,7 @@ class ServeEngine:
                 deadline_block=rd.get("deadline_block"),
                 tenant=rd.get("tenant", "default"),
                 adapter=rd.get("adapter"),
+                grammar=rd.get("grammar"),
             )
             if rd["state"] == "decoding":
                 eng._replay_q.append(
@@ -2244,8 +2566,10 @@ class ServeEngine:
                 args={"t": int(token), "i": len(out) - 1})
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._done[slot] = True
+            self._finish_reason.setdefault(req.request_id, "eos")
         if len(out) >= req.max_new_tokens:
             self._done[slot] = True
+            self._finish_reason.setdefault(req.request_id, "budget")
 
     def _retire_finished(self) -> None:
         finished = [i for i, r in enumerate(self.slots)
@@ -2289,6 +2613,10 @@ class ServeEngine:
                 self.tracer.counter("adapter_pool_pages",
                                     ("cache", "adapter"), pool.in_use(),
                                     block=self.blocks)
+        if self.grammar and tr_on:
+            self.tracer.counter("grammar_pool_slots", ("cache", "grammar"),
+                                self.session.grammars.in_use(),
+                                block=self.blocks)
         if self._slo is not None:
             fired = self._slo.observe_block(self.blocks)
             if fired and self.incident is not None:
@@ -2384,6 +2712,10 @@ class ServeEngine:
                 if (req is not None and slot not in self._prefilling
                         and not self._done[slot]):
                     self._record(slot, int(row[slot]), now)
+                    # DFA-state mirror: the same transition the device took
+                    # on this emitted token (accept-terminal latches done +
+                    # finish_reason="grammar_accept", like EOS)
+                    self._advance_grammar(slot, int(row[slot]))
             self._lengths += 1
             self._gen_counts += 1
         self._tok = toks[-1].astype(np.int32)
@@ -2407,7 +2739,9 @@ class ServeEngine:
                     jnp.asarray(self._done), jnp.asarray(self._eos),
                     jnp.asarray(self._temp), jnp.asarray(self._greedy),
                     *self.lm._ad_args(self.session.adapters,
-                                      self._adapter_idx))
+                                      self._adapter_idx),
+                    *self.lm._gr_args(self.session.grammars, self._gidx,
+                                      self._gstate, self._gbudget))
             toks, cache, _nxt, _len, _done = self._dispatch(
                 "decode", lambda: fused(*args))
             self.session.cache = cache
@@ -2422,10 +2756,22 @@ class ServeEngine:
         tok = self._tok.copy()
         lengths = self._lengths.copy()
         counts = self._gen_counts.copy()
+        gstate = self._gstate.copy()
+        gactive = self._gidx > 0
+        gtree = (self.session.grammars.tree
+                 if self.grammar and self.session.grammars is not None
+                 else None)
         max_len = self.lm.config.max_seq_len
         for i in range(self.block_steps):
             sub = jax.vmap(jax.random.fold_in)(self._slot_keys,
                                                jnp.asarray(counts))
+            allowed = None
+            if gtree is not None:
+                # same boolean math as the fused scan, on the same tables —
+                # the stepwise oracle replicates the device mask exactly
+                allowed = CausalLM.grammar_allowed(
+                    gtree, jnp.asarray(self._gidx), jnp.asarray(gstate),
+                    jnp.asarray(self._gbudget), jnp.asarray(counts))
             # direct decode call, NOT lm.step(): step() raises at the cache
             # edge, while the fused program latches done and lets the
             # (dropped) writes run out the block — the stepwise oracle must
@@ -2440,11 +2786,19 @@ class ServeEngine:
             self.session.cache = cache
             self.session.lengths += 1
             nxt = self._fetch(self.slot_sampler(logits[:, 0], sub, temp,
-                                                greedy))
+                                                greedy, allowed=allowed))
             self.stats["program_calls"] += 1
             self.stats["host_fetches"] += 1
+            done_before = done
             out[i] = np.where(done | ~self._active, self.pad_token_id, nxt)
             done = done | (self._active & (self._eos >= 0) & (nxt == self._eos))
+            if gtree is not None:
+                adv = gactive & self._active & ~done_before
+                new_state = np.asarray(
+                    gtree["next"])[self._gidx, gstate, nxt]
+                gstate = np.where(adv, new_state, gstate)
+                done = done | (adv & np.asarray(
+                    gtree["terminal"])[self._gidx, gstate])
             counts = counts + 1
             lengths = lengths + 1
             done = done | (self._active & (lengths + 1 >= max_len))
@@ -2591,6 +2945,14 @@ class ServeEngine:
                 "pinned": {n: pool.pinned(n) for n in sorted(pool.resident)
                            if pool.pinned(n)},
             }
+        if self.grammar:
+            gpool = self.session.grammars
+            out["grammars"] = {
+                "slots": gpool.n_slots,
+                "resident": sorted(gpool.resident),
+                "pinned": {n: gpool.pinned(n) for n in sorted(gpool.resident)
+                           if gpool.pinned(n)},
+            }
         return out
 
     def _sync_compile_metrics(self) -> None:
@@ -2645,6 +3007,8 @@ def synthetic_trace_stream(num_requests: int, vocab_size: int, *,
                            tenant_skew: float = 1.0,
                            adapters: int = 0,
                            adapter_skew: float = 1.0,
+                           grammar_frac: float = 0.0,
+                           grammars: Sequence[str] = (),
                            diurnal: float = 0.0,
                            diurnal_period_blocks: int = 64,
                            burst_every: int = 0,
@@ -2696,6 +3060,10 @@ def synthetic_trace_stream(num_requests: int, vocab_size: int, *,
         raise ValueError(f"adapters must be >= 0, got {adapters}")
     if adapter_skew < 0:
         raise ValueError(f"adapter_skew must be >= 0, got {adapter_skew}")
+    if not 0.0 <= grammar_frac <= 1.0:
+        raise ValueError(f"grammar_frac must be in [0, 1], got {grammar_frac}")
+    if grammar_frac > 0 and not grammars:
+        raise ValueError("grammar_frac > 0 needs grammars=(names...)")
     if prefix_families < 1:
         raise ValueError(f"prefix_families must be >= 1, got {prefix_families}")
     long_every = round(1 / long_prompt_frac) if long_prompt_frac > 0 else 0
@@ -2707,6 +3075,11 @@ def synthetic_trace_stream(num_requests: int, vocab_size: int, *,
     if tenants:
         w = 1.0 / np.arange(1, tenants + 1, dtype=np.float64) ** tenant_skew
         tenant_p = w / w.sum()
+    # structured-decoding labels ride their OWN stream (like adapters):
+    # adding grammar labels never shifts the tenant/adapter/arrival draws,
+    # and grammar_frac=0 is draw-for-draw identical to the historic trace
+    grammar_rs = np.random.RandomState(seed + 0x67)
+    grammar_count = 0
     adapter_p = None
     adapter_rs = np.random.RandomState(seed + 0x5A)   # independent stream
     if adapters:
@@ -2747,6 +3120,11 @@ def synthetic_trace_stream(num_requests: int, vocab_size: int, *,
         if adapter_p is not None:
             item["adapter"] = \
                 f"a{int(adapter_rs.choice(adapters, p=adapter_p))}"
+        if grammar_frac > 0 and grammar_rs.random_sample() < grammar_frac:
+            # cycle the grammar names over the CONSTRAINED subsequence so
+            # every grammar sees traffic at any frac (pool churn included)
+            item["grammar"] = grammars[grammar_count % len(grammars)]
+            grammar_count += 1
         yield item
 
 
@@ -2813,6 +3191,9 @@ def per_tenant_report(completions: List[Completion],
                      if not (c.deadline_missed or c.expired or c.cancelled))
         out[t] = {
             "requests": len(comps),
+            # structured share per tenant (zero on free-form-only tenants)
+            "constrained_requests": sum(1 for c in comps
+                                        if c.grammar is not None),
             "generated_tokens": int(sum(len(c.tokens) for c in comps)),
             "itl_p50_ms": round(float(np.percentile(gaps, 50)), 3)
             if gaps else None,
@@ -2862,7 +3243,8 @@ def run_trace(engine: ServeEngine, trace: List[dict],
                             ttft_deadline_ms=item.get("ttft_deadline_ms"),
                             deadline_ms=item.get("deadline_ms"),
                             tenant=item.get("tenant", "default"),
-                            adapter=item.get("adapter"))
+                            adapter=item.get("adapter"),
+                            grammar=item.get("grammar"))
         rid = out.request_id if isinstance(out, Rejected) else out
         tenant_of[rid] = item.get("tenant", "default")
     t0 = time.perf_counter()
@@ -2980,6 +3362,52 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             completions, tok_ts, wall_s,
             [tenant_of.get(r.request_id, "default")
              for r in engine.rejected])
+    if getattr(engine, "grammar", False):
+        # structured-decoding surface (ISSUE 13): the constrained share of
+        # the trace and its latency split vs the free-form tenants riding
+        # the same pool — the "masking must not stall the pool" evidence —
+        # plus the pool's load/evict/repair cycle and finish reasons
+        gpool = engine.session.grammars
+
+        def _split(pred):
+            comps = [c for c in completions if pred(c)]
+            gaps: List[float] = []
+            for c in comps:
+                ts = tok_ts.get(c.request_id, np.zeros((0,)))
+                gg = np.diff(ts) * 1e3 if ts.size > 1 else np.zeros((0,))
+                gaps.extend(gg[gg > 0.0].tolist())
+            return {
+                "requests": len(comps),
+                "itl_p50_ms": round(float(np.percentile(gaps, 50)), 3)
+                if gaps else None,
+                "itl_p99_ms": round(float(np.percentile(gaps, 99)), 3)
+                if gaps else None,
+                "ttft_blocks_mean": round(float(np.mean(
+                    [c.ttft_blocks for c in comps])), 2) if comps else None,
+            }
+
+        constrained = [c for c in completions if c.grammar is not None]
+        report["structured"] = {
+            "constrained_requests": len(constrained),
+            "constrained_share": (round(len(constrained) / len(completions),
+                                        3) if completions else None),
+            "constrained": _split(lambda c: c.grammar is not None),
+            "freeform": _split(lambda c: c.grammar is None),
+            "finish_reasons": {
+                r: sum(1 for c in completions if c.finish_reason == r)
+                for r in sorted({c.finish_reason for c in completions})},
+            "grammar_slots": gpool.n_slots,
+            "grammars_resident": sorted(gpool.resident),
+            "grammar_loads": gpool.stats["loads"],
+            "grammar_evictions": gpool.stats["evictions"],
+            "grammar_hits": gpool.stats["hits"],
+            "grammar_repairs": gpool.stats["repairs"],
+            "grammar_rejects": engine.stats["grammar_rejects"],
+            "grammar_load_retries": engine.stats["grammar_load_retries"],
+            "grammar_bytes_per_slot": gpool.grammar_bytes(),
+            "grammar_compile_ms": {
+                n: gpool.compile_ms_of(n) for n in sorted(gpool._registry)},
+        }
     if getattr(engine, "lora", False):
         # multi-LoRA surface: pool residency + the load/evict/repair cycle
         # — the "one compiled program, any adapter mix" evidence

@@ -52,6 +52,16 @@ in the same order, so "replay the storm" is a one-line reproducer:
   sampled), which the per-request rng contract keeps bit-identical: a
   migration fault is a latency event, never a wrong token — which the
   disaggregation chaos tests assert.
+* **grammar** (``FaultInjector.on_grammar_acquire``) — per grammar-pool
+  acquire (structured decoding, ``inference/grammar.py``), the table load
+  may FAIL outright (``grammar_load_fail_prob`` — the admission requeues
+  and retries at a later block) or the resident slot's DEVICE mask table
+  may be physically garbled first (``grammar_corrupt_prob`` — the pool's
+  per-grammar checksum catches it and repairs from the host registry,
+  which is exactly the failure that would otherwise emit an
+  out-of-grammar token). Either way the stream is only ever decoded under
+  its OWN, intact mask tables: a grammar fault is a latency event, never
+  an unparseable completion — which the structured chaos tests assert.
 * **tier** (``FaultInjector.on_tier_restore``) — per host-tier page read,
   the restore may FAIL outright (``tier_restore_fail_prob`` — an IO error:
   the entry is dropped, the admission re-prefills the suffix) or the tier
@@ -104,6 +114,8 @@ class FaultPlan:
     tier_corrupt_prob: float = 0.0
     adapter_load_fail_prob: float = 0.0
     adapter_corrupt_prob: float = 0.0
+    grammar_load_fail_prob: float = 0.0
+    grammar_corrupt_prob: float = 0.0
     migrate_fail_prob: float = 0.0
     migrate_corrupt_prob: float = 0.0
 
@@ -112,6 +124,7 @@ class FaultPlan:
                      "corrupt_page_prob", "replica_crash_prob",
                      "tier_restore_fail_prob", "tier_corrupt_prob",
                      "adapter_load_fail_prob", "adapter_corrupt_prob",
+                     "grammar_load_fail_prob", "grammar_corrupt_prob",
                      "migrate_fail_prob", "migrate_corrupt_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
@@ -123,6 +136,10 @@ class FaultPlan:
         if self.adapter_load_fail_prob + self.adapter_corrupt_prob > 1.0:
             raise ValueError(
                 "adapter_load_fail_prob + adapter_corrupt_prob must be <= 1 "
+                "(one verdict per acquire)")
+        if self.grammar_load_fail_prob + self.grammar_corrupt_prob > 1.0:
+            raise ValueError(
+                "grammar_load_fail_prob + grammar_corrupt_prob must be <= 1 "
                 "(one verdict per acquire)")
         if self.migrate_fail_prob + self.migrate_corrupt_prob > 1.0:
             raise ValueError(
@@ -163,7 +180,7 @@ class FaultInjector:
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
             for seam in ("alloc", "dispatch", "corrupt", "replica", "tier",
-                         "adapter", "migrate")
+                         "adapter", "grammar", "migrate")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
@@ -172,6 +189,7 @@ class FaultInjector:
                       "pages_corrupted": 0, "replica_crashes": 0,
                       "tier_restore_faults": 0, "tier_corruptions": 0,
                       "adapter_load_faults": 0, "adapter_corruptions": 0,
+                      "grammar_load_faults": 0, "grammar_corruptions": 0,
                       "migrate_faults": 0, "migrate_corruptions": 0}
 
     # --- allocator seam --------------------------------------------------
@@ -298,6 +316,29 @@ class FaultInjector:
             return "fail"
         if u < flp + acp:
             self.stats["adapter_corruptions"] += 1
+            return "corrupt"
+        return None
+
+    # --- grammar seam ----------------------------------------------------
+
+    def on_grammar_acquire(self) -> Optional[str]:
+        """Called by ``GrammarPool.acquire`` before each pin: one draw
+        decides the verdict — ``'fail'`` (table load IO error: the
+        admission requeues and retries a later block), ``'corrupt'`` (the
+        resident slot's device mask table is garbled; the pool's checksum
+        catches it and repairs from the host registry), or None. One draw
+        per acquire keeps the seam's schedule independent of which verdict
+        fired — the adapter/tier seams' discipline."""
+        flp = self.plan.grammar_load_fail_prob
+        gcp = self.plan.grammar_corrupt_prob
+        if not (flp or gcp):
+            return None
+        u = self._rs["grammar"].random_sample()
+        if u < flp:
+            self.stats["grammar_load_faults"] += 1
+            return "fail"
+        if u < flp + gcp:
+            self.stats["grammar_corruptions"] += 1
             return "corrupt"
         return None
 

@@ -1,0 +1,941 @@
+"""Structured decoding: grammar-constrained generation compiled to a
+token-level DFA enforced inside the fused decode scan (Outlines, Willard &
+Louf 2023; XGrammar 2024 — PAPERS.md serving rows).
+
+The observation that makes constrained decoding compatible with the
+engine's ≤2-host-ops-per-block contract: a regular constraint (regex, or a
+JSON-schema subset lowered to one) compiles AHEAD OF TIME into a finite
+automaton over the TOKEN vocabulary — a dense ``(states, vocab)`` allowed
+mask (stored fused with the budget distance as the ``need`` table: the
+mask is ``need < INF``) plus a ``(states, vocab)`` next-state table — so
+per-step enforcement is one row gather, two compares and a ``where`` on
+logits, all inside the compiled scan. No per-token host work, no
+recompiles when the grammar mix changes (the tables are program INPUTS,
+exactly like the PR 10 adapter pool).
+
+Compilation pipeline (host-side, once per grammar):
+
+1. regex subset (literals, escapes ``\\d \\w \\s``, classes ``[a-z0-9]``
+   incl. negation, ``.``, ``* + ?``, ``{m} {m,} {m,n}``, ``|``, groups) —
+   parsed to a Thompson NFA, determinized over the alphabet of characters
+   that actually occur in the token table (characters no token can produce
+   cannot matter);
+2. the char-DFA is composed with the token vocabulary: token ``v`` is
+   allowed from state ``s`` iff walking its characters stays inside the
+   DFA; ``next[s, v]`` is where the walk ends (−1 = forbidden);
+3. a token-level shortest-distance-to-accept ``dist[s]`` is computed and
+   transitions into states that can never reach an accept state are masked
+   off — plus the BUDGET-AWARE guarantee: at decode time a transition is
+   only allowed while ``dist[next] <= remaining_tokens − 1``, so when the
+   budget runs out the stream is ALWAYS in an accept state (the
+   ``serve_structured_parse_rate == 1.0`` oracle is a theorem, not a
+   hope). ``submit(grammar=)`` rejects budgets below ``dist[start]``.
+
+Termination: a state that is accepting AND has no allowed tokens is
+*accept-terminal* — entering it freezes the slot exactly like EOS
+(``finish_reason="grammar_accept"``). Grammars whose accept states keep
+outgoing transitions (``a+``-style) terminate through the budget-aware
+mask instead, ending in an accept state (``finish_reason="budget"``,
+output still parses).
+
+:class:`GrammarPool` manages device residency with the PR 10
+:class:`~neuronx_distributed_tpu.inference.adapters.AdapterPool`
+discipline verbatim: padded ``(n_slots, max_states, vocab)`` stacks,
+refcounted residency (residency hold + per-admission pins, LRU eviction of
+unpinned grammars), crc32 over the padded host bytes re-verified against
+the DEVICE copy on each acquire with repair from the host registry (the
+``grammar`` fault seam of ``inference/faults.py``), and slot 0 as the
+accept-everything identity grammar — an all-ones mask leaves
+``where(mask, logits, −1e30)`` bit-identical to untouched logits, so
+unconstrained requests in a mixed pool emit the EXACT streams of a pool
+compiled with no grammar support at all.
+
+Sizing: one resident grammar costs ``max_states × vocab × 8`` bytes
+(int32 need + int32 next) plus ``max_states`` terminal bytes — the
+README's mask-table sizing formula (pool bytes = ``n_slots ×`` that).
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference.paged_cache import PageAllocator
+
+# unreachable-accept sentinel: far above any real token distance, far below
+# int32 overflow when the scan adds small offsets
+_INF = np.int32(2 ** 30)
+
+
+class GrammarCompileError(ValueError):
+    """The pattern failed to compile to a completable token DFA (syntax
+    error, no token sequence can ever match, or the DFA exceeds the pool's
+    ``max_states``). Raised at ``register_grammar`` / submit time — never
+    after device work started."""
+
+
+class GrammarPoolExhausted(RuntimeError):
+    """Every non-identity pool slot is pinned by an in-flight request and
+    nothing is evictable — the admission is shed with a structured
+    ``Rejected(reason="grammar_pool_exhausted")`` (pins return as streams
+    retire)."""
+
+
+class GrammarLoadError(RuntimeError):
+    """A grammar table load failed (injected IO fault). Deterministic and
+    retryable: the admission requeues and retries at a later block — the
+    request is never decoded under a missing or half-written mask table."""
+
+
+def default_token_table(vocab_size: int) -> Tuple[str, ...]:
+    """Deterministic token-id → string table for vocabularies that have no
+    real tokenizer attached (the synthetic-trace serving stack): id 0 is
+    the pad token (empty string — never allowed by any grammar), ids 1..95
+    are the printable ASCII characters, and the remaining ids cycle through
+    two-character strings over ``[a-z0-9]`` so multi-character DFA walks
+    are exercised. Real deployments pass their tokenizer's
+    ``convert_ids_to_tokens`` strings instead."""
+    table: List[str] = [""]
+    table.extend(chr(c) for c in range(32, 127))
+    alpha = "abcdefghijklmnopqrstuvwxyz0123456789"
+    i = 0
+    while len(table) < vocab_size:
+        a, b = divmod(i, len(alpha))
+        i += 1
+        table.append(alpha[a % len(alpha)] + alpha[b])
+    return tuple(table[:vocab_size])
+
+
+def detokenize(token_ids: Sequence[int], table: Sequence[str]) -> str:
+    """Token ids → text under a token table (the parse-oracle read path)."""
+    return "".join(table[int(t)] for t in token_ids)
+
+
+# --- regex subset: parser → Thompson NFA ---------------------------------
+# A predicate is (chars, negated): the edge accepts c iff (c in chars) XOR
+# negated. ``.`` is (frozenset(), True) — any char the token table can
+# produce.
+
+_Pred = Tuple[FrozenSet[str], bool]
+_ESCAPES: Dict[str, _Pred] = {
+    "d": (frozenset("0123456789"), False),
+    "w": (frozenset(
+        "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+        False),
+    "s": (frozenset(" \t\n\r\f\v"), False),
+}
+
+
+class _NFA:
+    """Thompson fragment collection: integer states, predicate edges and
+    epsilon edges; one start, one final per build step."""
+
+    def __init__(self):
+        self.edges: List[Tuple[int, _Pred, int]] = []
+        self.eps: List[Tuple[int, int]] = []
+        self.n = 0
+
+    def state(self) -> int:
+        self.n += 1
+        return self.n - 1
+
+
+class _Parser:
+    """Recursive-descent parser for the supported regex subset. Produces
+    (start, final) fragments on one shared :class:`_NFA`."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.nfa = _NFA()
+
+    def _err(self, msg: str) -> GrammarCompileError:
+        return GrammarCompileError(
+            f"regex error at position {self.i} in {self.p!r}: {msg}")
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self) -> Tuple[int, int]:
+        frag = self._alt()
+        if self.i != len(self.p):
+            raise self._err(f"unexpected {self.p[self.i]!r}")
+        return frag
+
+    def _alt(self) -> Tuple[int, int]:
+        frags = [self._concat()]
+        while self.peek() == "|":
+            self.take()
+            frags.append(self._concat())
+        if len(frags) == 1:
+            return frags[0]
+        s, f = self.nfa.state(), self.nfa.state()
+        for fs, ff in frags:
+            self.nfa.eps.append((s, fs))
+            self.nfa.eps.append((ff, f))
+        return s, f
+
+    def _concat(self) -> Tuple[int, int]:
+        frags = []
+        while self.peek() is not None and self.peek() not in "|)":
+            frags.append(self._repeat())
+        if not frags:
+            s = self.nfa.state()
+            return s, s           # empty branch (e.g. "(a|)" or "")
+        s, f = frags[0]
+        for ns, nf in frags[1:]:
+            self.nfa.eps.append((f, ns))
+            f = nf
+        return s, f
+
+    def _repeat(self) -> Tuple[int, int]:
+        frag = self._atom()
+        while True:
+            c = self.peek()
+            if c == "*":
+                self.take()
+                frag = self._star(frag, plus=False)
+            elif c == "+":
+                self.take()
+                frag = self._star(frag, plus=True)
+            elif c == "?":
+                self.take()
+                frag = self._opt(frag)
+            elif c == "{":
+                frag = self._bounded(frag)
+            else:
+                return frag
+
+    def _star(self, frag: Tuple[int, int], plus: bool) -> Tuple[int, int]:
+        fs, ff = frag
+        s, f = self.nfa.state(), self.nfa.state()
+        self.nfa.eps += [(s, fs), (ff, f), (ff, fs)]
+        if not plus:
+            self.nfa.eps.append((s, f))
+        return s, f
+
+    def _opt(self, frag: Tuple[int, int]) -> Tuple[int, int]:
+        fs, ff = frag
+        s, f = self.nfa.state(), self.nfa.state()
+        self.nfa.eps += [(s, fs), (ff, f), (s, f)]
+        return s, f
+
+    def _bounded(self, frag: Tuple[int, int]) -> Tuple[int, int]:
+        # {m} / {m,} / {m,n} — implemented by re-parsing the atom the frag
+        # came from would lose group structure, so the frag is CLONED via
+        # state remapping instead
+        start_i = self.i
+        self.take()  # '{'
+        spec = ""
+        while self.peek() is not None and self.peek() != "}":
+            spec += self.take()
+        if self.peek() != "}":
+            self.i = start_i
+            raise self._err("unterminated {m,n} quantifier")
+        self.take()
+        parts = spec.split(",")
+        try:
+            lo = int(parts[0])
+            hi = (lo if len(parts) == 1
+                  else (None if parts[1] == "" else int(parts[1])))
+        except ValueError:
+            raise self._err(f"bad quantifier {{{spec}}}") from None
+        if lo < 0 or (hi is not None and hi < lo):
+            raise self._err(f"bad quantifier bounds {{{spec}}}")
+        if hi is not None and hi == 0:
+            s = self.nfa.state()
+            return s, s
+        clones = [frag] + [self._clone(frag)
+                           for _ in range((hi or lo + 1) - 1)]
+        if hi is None:
+            clones.append(self._star(self._clone(frag), plus=False))
+        s, f = self.nfa.state(), self.nfa.state()
+        self.nfa.eps.append((s, clones[0][0]))
+        for k in range(len(clones) - 1):
+            self.nfa.eps.append((clones[k][1], clones[k + 1][0]))
+        self.nfa.eps.append((clones[-1][1], f))
+        # exits after each completed optional repetition (k >= lo)
+        for k in range(max(lo, 1) - 1, len(clones)):
+            self.nfa.eps.append((clones[k][1], f))
+        if lo == 0:
+            self.nfa.eps.append((s, f))
+        return s, f
+
+    def _clone(self, frag: Tuple[int, int]) -> Tuple[int, int]:
+        """Deep-copy a fragment's reachable subgraph with fresh states."""
+        fs, ff = frag
+        # reachable states of the fragment
+        adj: Dict[int, List[int]] = {}
+        for a, _pr, b in self.nfa.edges:
+            adj.setdefault(a, []).append(b)
+        for a, b in self.nfa.eps:
+            adj.setdefault(a, []).append(b)
+        seen = {fs}
+        stack = [fs]
+        while stack:
+            x = stack.pop()
+            for y in adj.get(x, ()):
+                if y not in seen:
+                    seen.add(y)
+                    stack.append(y)
+        remap = {x: self.nfa.state() for x in seen}
+        for a, pr, b in list(self.nfa.edges):
+            if a in remap and b in remap:
+                self.nfa.edges.append((remap[a], pr, remap[b]))
+        for a, b in list(self.nfa.eps):
+            if a in remap and b in remap:
+                self.nfa.eps.append((remap[a], remap[b]))
+        return remap[fs], remap.get(ff, remap[fs])
+
+    def _atom(self) -> Tuple[int, int]:
+        c = self.peek()
+        if c is None:
+            raise self._err("dangling quantifier or empty atom")
+        if c == "(":
+            self.take()
+            frag = self._alt()
+            if self.peek() != ")":
+                raise self._err("unbalanced '('")
+            self.take()
+            return frag
+        if c == "[":
+            return self._edge(self._char_class())
+        if c == ".":
+            self.take()
+            return self._edge((frozenset(), True))
+        if c == "\\":
+            self.take()
+            return self._edge(self._escape())
+        if c in "*+?{":
+            raise self._err(f"quantifier {c!r} with nothing to repeat")
+        if c in ")|":
+            raise self._err(f"unexpected {c!r}")
+        self.take()
+        return self._edge((frozenset(c), False))
+
+    def _escape(self) -> _Pred:
+        if self.peek() is None:
+            raise self._err("dangling escape")
+        e = self.take()
+        if e in _ESCAPES:
+            return _ESCAPES[e]
+        return (frozenset(e), False)     # \. \\ \[ \{ \" etc: literal
+
+    def _char_class(self) -> _Pred:
+        self.take()  # '['
+        negated = False
+        if self.peek() == "^":
+            negated = True
+            self.take()
+        chars: set = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise self._err("unterminated character class")
+            if c == "]" and not first:
+                self.take()
+                break
+            first = False
+            if c == "\\":
+                self.take()
+                pr = self._escape()
+                if pr[1]:
+                    raise self._err("negated escape inside class")
+                chars |= set(pr[0])
+                continue
+            self.take()
+            if self.peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self.take()
+                hi = self.take()
+                if hi == "\\":
+                    hi = self.take()
+                if ord(hi) < ord(c):
+                    raise self._err(f"bad range {c}-{hi}")
+                chars |= {chr(x) for x in range(ord(c), ord(hi) + 1)}
+            else:
+                chars.add(c)
+        return (frozenset(chars), negated)
+
+    def _edge(self, pred: _Pred) -> Tuple[int, int]:
+        s, f = self.nfa.state(), self.nfa.state()
+        self.nfa.edges.append((s, pred, f))
+        return s, f
+
+
+def _pred_accepts(pred: _Pred, c: str) -> bool:
+    chars, negated = pred
+    return (c not in chars) if negated else (c in chars)
+
+
+def _char_dfa(pattern: str, alphabet: Sequence[str]
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Compile ``pattern`` to a dense char-DFA over ``alphabet``: returns
+    (``next (S, A) int32`` with −1 = dead, ``accept (S,) bool``). Subset
+    construction; state 0 is the start."""
+    parser = _Parser(pattern)
+    start, final = parser.parse()
+    nfa = parser.nfa
+    eps_adj: Dict[int, List[int]] = {}
+    for a, b in nfa.eps:
+        eps_adj.setdefault(a, []).append(b)
+    edges_by_src: Dict[int, List[Tuple[_Pred, int]]] = {}
+    for a, pr, b in nfa.edges:
+        edges_by_src.setdefault(a, []).append((pr, b))
+
+    def closure(states: FrozenSet[int]) -> FrozenSet[int]:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            x = stack.pop()
+            for y in eps_adj.get(x, ()):
+                if y not in out:
+                    out.add(y)
+                    stack.append(y)
+        return frozenset(out)
+
+    start_set = closure(frozenset([start]))
+    ids: Dict[FrozenSet[int], int] = {start_set: 0}
+    order = [start_set]
+    rows: List[List[int]] = []
+    accept: List[bool] = []
+    qi = 0
+    while qi < len(order):
+        cur = order[qi]
+        qi += 1
+        accept.append(final in cur)
+        row = []
+        for c in alphabet:
+            moved = {b for x in cur for pr, b in edges_by_src.get(x, ())
+                     if _pred_accepts(pr, c)}
+            if not moved:
+                row.append(-1)
+                continue
+            nxt = closure(frozenset(moved))
+            if nxt not in ids:
+                ids[nxt] = len(order)
+                order.append(nxt)
+            row.append(ids[nxt])
+        rows.append(row)
+    return (np.asarray(rows, np.int32).reshape(len(order), len(alphabet)),
+            np.asarray(accept, bool))
+
+
+# --- JSON-schema subset → regex ------------------------------------------
+
+_RE_SPECIALS = set("\\.[](){}|*+?^$-")
+
+
+def regex_escape(s: str) -> str:
+    """Escape ``s`` for literal use in this module's regex dialect."""
+    return "".join("\\" + c if c in _RE_SPECIALS else c for c in s)
+
+
+_STRING_RE = '"[^"\\\\]*"'          # no escapes/control chars: compact JSON
+_INT_RE = "-?(0|[1-9][0-9]*)"
+_NUMBER_RE = "-?(0|[1-9][0-9]*)(\\.[0-9]+)?"
+_BOOL_RE = "(true|false)"
+
+
+def json_schema_to_regex(schema: dict) -> str:
+    """Lower the supported JSON-schema subset to a regex over COMPACT JSON
+    (no whitespace, no string escapes — ``json.loads`` accepts every match).
+    Supported: ``object`` (every listed property required, emitted in
+    declaration order), ``string`` (optional ``enum``), ``integer``,
+    ``number``, ``boolean``, ``array`` of any supported item type
+    (``minItems``/``maxItems`` honored), and ``null``. Anything else raises
+    :class:`GrammarCompileError`."""
+    if not isinstance(schema, dict):
+        raise GrammarCompileError(f"schema must be an object, got {schema!r}")
+    t = schema.get("type")
+    if "enum" in schema:
+        vals = schema["enum"]
+        if not vals or not all(isinstance(v, str) for v in vals):
+            raise GrammarCompileError(
+                "enum supports non-empty string lists only")
+        return "(" + "|".join(f'"{regex_escape(v)}"' for v in vals) + ")"
+    if t == "string":
+        return _STRING_RE
+    if t == "integer":
+        return _INT_RE
+    if t == "number":
+        return _NUMBER_RE
+    if t == "boolean":
+        return _BOOL_RE
+    if t == "null":
+        return "null"
+    if t == "array":
+        item = json_schema_to_regex(schema.get("items", {"type": "string"}))
+        lo = int(schema.get("minItems", 0))
+        hi = schema.get("maxItems")
+        if lo < 0 or (hi is not None and int(hi) < lo):
+            raise GrammarCompileError("bad minItems/maxItems")
+        if hi is not None:
+            hi = int(hi)
+            if hi == 0:
+                return "\\[\\]"
+            more = f"(,{item}){{{max(lo - 1, 0)},{hi - 1}}}"
+            body = f"{item}{more}"
+            return (f"\\[{body}\\]" if lo > 0
+                    else f"(\\[\\]|\\[{body}\\])")
+        body = f"{item}(,{item})*"
+        if lo > 1:
+            body = f"{item}(,{item}){{{lo - 1},}}"
+        return (f"\\[{body}\\]" if lo > 0
+                else f"(\\[\\]|\\[{body}\\])")
+    if t == "object":
+        props = schema.get("properties", {})
+        if not props:
+            return "\\{\\}"
+        parts = [f'"{regex_escape(k)}":{json_schema_to_regex(v)}'
+                 for k, v in props.items()]
+        return "\\{" + ",".join(parts) + "\\}"
+    raise GrammarCompileError(f"unsupported schema type {t!r}")
+
+
+# --- token-level DFA ------------------------------------------------------
+
+
+class CompiledGrammar:
+    """One grammar's host-side token DFA: dense ``next (S, V) int32`` (−1 =
+    forbidden), the derived ``mask``, per-state shortest token-distance to
+    an accept state (``dist``, with budget-aware masking this is the
+    termination guarantee), accept flags, and accept-terminal flags.
+    State 0 is the start state."""
+
+    def __init__(self, pattern: str, next_tok: np.ndarray,
+                 accept: np.ndarray, compile_ms: float):
+        self.pattern = pattern
+        self.next = next_tok                      # (S, V) int32
+        self.accept = accept                      # (S,) bool
+        self.n_states, self.vocab = next_tok.shape
+        self.compile_ms = compile_ms
+        # token-level shortest distance to ANY accept state (BFS backward)
+        dist = np.full((self.n_states,), _INF, np.int64)
+        dist[accept] = 0
+        succ = [np.unique(next_tok[s][next_tok[s] >= 0])
+                for s in range(self.n_states)]
+        changed = True
+        while changed:
+            changed = False
+            for s in range(self.n_states):
+                if len(succ[s]) == 0:
+                    continue
+                d = dist[succ[s]].min() + 1
+                if d < dist[s]:
+                    dist[s] = d
+                    changed = True
+        # transitions into never-accepting states are masked off: they can
+        # only ever produce output that fails to parse
+        dead = dist[np.clip(next_tok, 0, None)] >= _INF
+        self.next = np.where((next_tok >= 0) & ~dead, next_tok, -1)
+        self.mask = self.next >= 0                # (S, V) bool
+        self.dist = np.minimum(dist, _INF).astype(np.int32)
+        self.terminal = accept & ~self.mask.any(axis=1)
+        self.min_tokens = int(self.dist[0])
+        if self.min_tokens >= _INF:
+            raise GrammarCompileError(
+                f"grammar {pattern!r} matches no token sequence over this "
+                f"token table")
+        if self.min_tokens == 0 and not self.mask[0].any():
+            raise GrammarCompileError(
+                f"grammar {pattern!r} accepts only the empty string — a "
+                f"decode stream must emit at least one token")
+        # budget-aware allowed-token distance: dist[next[s, v]] (the scan
+        # gathers this same quantity from the device dist table)
+        self.dist_next = np.where(
+            self.mask, self.dist[np.clip(self.next, 0, None)], _INF
+        ).astype(np.int32)
+
+    def allowed_row(self, state: int, remaining_after: int) -> np.ndarray:
+        """The (V,) allowed mask from ``state`` with ``remaining_after``
+        tokens of budget left AFTER the one about to be sampled — the exact
+        boolean the device scan computes (budget-aware: only transitions
+        that can still reach an accept state in time). Falls back to the
+        plain mask if the budget-aware set empties (can only happen for
+        rows the scheduler already froze)."""
+        ok = self.mask[state] & (self.dist_next[state] <= remaining_after)
+        return ok if ok.any() else self.mask[state]
+
+    def walk(self, state: int, token_id: int) -> int:
+        """One token transition (−1 = forbidden from this state)."""
+        return int(self.next[state, int(token_id)])
+
+    def fullmatch_ids(self, token_ids: Sequence[int]) -> bool:
+        """Whether the token sequence drives start → accept (the parse
+        oracle, evaluated on the DFA itself)."""
+        s = 0
+        for t in token_ids:
+            s = int(self.next[s, int(t)])
+            if s < 0:
+                return False
+        return bool(self.accept[s])
+
+
+def compile_token_dfa(pattern: str, token_strs: Sequence[str],
+                      json_schema: Optional[dict] = None) -> CompiledGrammar:
+    """Compile a regex (or JSON schema, lowered first) against a token
+    table into a :class:`CompiledGrammar`. The char-DFA is determinized
+    over exactly the characters the token table can produce; the token
+    composition is a vectorized walk of every token from every state."""
+    t0 = time.perf_counter()
+    if json_schema is not None:
+        pattern = json_schema_to_regex(json_schema)
+    alphabet = sorted({c for t in token_strs for c in t})
+    if not alphabet:
+        raise GrammarCompileError("token table produces no characters")
+    char_ix = {c: i for i, c in enumerate(alphabet)}
+    cnext, caccept = _char_dfa(pattern, alphabet)
+    S = cnext.shape[0]
+    V = len(token_strs)
+    next_tok = np.full((S, V), -1, np.int32)
+    # group tokens by length; one vectorized (S, n_tok) walk per group
+    by_len: Dict[int, List[int]] = {}
+    for v, t in enumerate(token_strs):
+        if t:                         # empty tokens are never allowed
+            by_len.setdefault(len(t), []).append(v)
+    for L, vs in by_len.items():
+        ids = np.asarray(
+            [[char_ix.get(c, -1) for c in token_strs[v]] for v in vs],
+            np.int64)                                   # (n, L)
+        st = np.broadcast_to(np.arange(S, dtype=np.int64)[:, None],
+                             (S, len(vs))).copy()        # (S, n)
+        for j in range(L):
+            cj = ids[:, j][None, :]                      # (1, n)
+            stepped = np.where(
+                cj >= 0,
+                cnext[np.clip(st, 0, None), np.clip(cj, 0, None)], -1)
+            st = np.where(st >= 0, stepped, -1)
+        next_tok[:, vs] = st.astype(np.int32)
+    ms = (time.perf_counter() - t0) * 1e3
+    return CompiledGrammar(pattern, next_tok, caccept, round(ms, 3))
+
+
+# --- device-resident pool -------------------------------------------------
+
+_LEAVES = ("need", "next", "terminal")
+
+
+class GrammarPool:
+    """Device-resident pool of ``n_slots`` compiled grammars padded to
+    ``max_states`` over one serving vocab — residency managed with the
+    :class:`~neuronx_distributed_tpu.inference.adapters.AdapterPool`
+    pattern verbatim (refcounted slots, LRU eviction of unpinned,
+    crc-verified acquire with repair, slot 0 = the accept-everything
+    identity so unconstrained rows are bit-identical to a grammarless
+    pool). ``tree`` is the concrete table stack every fused decode program
+    consumes; the host mutates it functionally between blocks
+    (``.at[slot].set`` — the ``_set_block_tables`` discipline).
+
+    One pool per SESSION: router replicas sharing a CausalLM each hold
+    their own residency state while reusing the same compiled programs —
+    the pool is an input, not a constant."""
+
+    def __init__(self, n_slots: int, max_states: int,
+                 token_strs: Sequence[str]):
+        if n_slots < 2:
+            raise ValueError(
+                f"grammar pool needs >= 2 slots (slot 0 is the identity "
+                f"grammar), got {n_slots}")
+        if max_states < 2:
+            raise ValueError(f"max_states must be >= 2, got {max_states}")
+        self.n_slots = int(n_slots)
+        self.max_states = int(max_states)
+        self.token_strs = tuple(token_strs)
+        V = len(self.token_strs)
+        self.vocab = V
+        G, S = self.n_slots, self.max_states
+        # slot 0 = identity: need all-zero (every token allowed under any
+        # budget -> the derived mask is all-ones and where() leaves logits
+        # untouched bit-for-bit), next 0, terminal False. "need[s, v]" is
+        # the budget still required AFTER taking token v from state s
+        # (dist[next[s, v]]; _INF = forbidden) — ONE fused table instead of
+        # separate mask + dist gathers, keeping the in-scan cost to a
+        # single (b, vocab) gather plus two compares per step.
+        self.tree = {
+            "need": jnp.concatenate(
+                [jnp.zeros((1, S, V), jnp.int32),
+                 jnp.full((G - 1, S, V), _INF, jnp.int32)]),
+            "next": jnp.zeros((G, S, V), jnp.int32),
+            "terminal": jnp.zeros((G, S), bool),
+        }
+        self.allocator = PageAllocator(self.n_slots, reserved=1)
+        self.resident: Dict[str, int] = {}
+        self._registry: Dict[str, dict] = {}
+        self._last_used: Dict[str, int] = {}
+        self._clock = 0
+        self.fault_hook: Optional[Callable[[], Optional[str]]] = None
+        self.stats = {"compiles": 0, "loads": 0, "evictions": 0, "pins": 0,
+                      "releases": 0, "hits": 0, "repairs": 0,
+                      "load_failures": 0, "resident_peak": 0}
+        self._tracer = None
+        self._block_fn = None
+        self._m_slots = None
+        self._m_load = None
+        self._m_compile = None
+
+    # --- observability ---------------------------------------------------
+
+    def attach_observability(self, tracer, metrics, block_fn=None) -> None:
+        """Grammar lifecycle instants (``grammar:compile/load/evict/pin``
+        on the ``("cache", "grammar")`` lane), the slots-in-use gauge and
+        the compile/load latency histograms — host-side only, the
+        ``AdapterPool.attach_observability`` contract."""
+        self._tracer = tracer
+        self._block_fn = block_fn
+        self._m_slots = metrics.gauge(
+            "serve_grammar_slots_in_use",
+            help="device-resident grammars (identity slot excluded)")
+        self._m_load = metrics.histogram(
+            "serve_grammar_load_ms",
+            help="cold grammar table load wall ms (device slot write)",
+            lo=0.01)
+        self._m_compile = metrics.histogram(
+            "grammar_compile_ms",
+            help="regex/schema -> token-DFA compile wall ms", lo=0.01)
+
+    def _note(self, name: str, **args) -> None:
+        if self._m_slots is not None:
+            self._m_slots.set(self.in_use())
+        if self._tracer is not None and self._tracer.enabled:
+            block = None if self._block_fn is None else int(self._block_fn())
+            self._tracer.instant(name, ("cache", "grammar"), block=block,
+                                 args={**args, "resident": self.in_use()})
+
+    # --- introspection ---------------------------------------------------
+
+    def registered(self, name: str) -> bool:
+        return name in self._registry
+
+    def is_resident(self, name: str) -> bool:
+        return name in self.resident
+
+    def slot_of(self, name: str) -> int:
+        return self.resident[name]
+
+    def in_use(self) -> int:
+        return self.allocator.in_use()
+
+    def pinned(self, name: str) -> int:
+        slot = self.resident.get(name)
+        return 0 if slot is None else max(
+            int(self.allocator.refcount[slot]) - 1, 0)
+
+    def grammar(self, name: str) -> CompiledGrammar:
+        return self._registry[name]["dfa"]
+
+    def min_tokens(self, name: str) -> int:
+        """Fewest generated tokens any match needs — ``submit(grammar=)``
+        rejects budgets below this (the stream could NEVER parse)."""
+        return self._registry[name]["dfa"].min_tokens
+
+    def compile_ms_of(self, name: str) -> float:
+        return self._registry[name]["dfa"].compile_ms
+
+    def grammar_bytes(self) -> int:
+        """Bytes ONE resident grammar occupies on device: ``max_states ×
+        vocab × 8`` (int32 need + int32 next) + ``max_states`` — the
+        per-slot unit of the README mask-table sizing formula."""
+        total = 0
+        for k in _LEAVES:
+            leaf = self.tree[k]
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize \
+                // self.n_slots
+        return total
+
+    # --- registration ----------------------------------------------------
+
+    def register(self, name: str, regex: Optional[str] = None,
+                 json_schema: Optional[dict] = None) -> CompiledGrammar:
+        """Compile and store ``name``'s token DFA (host-side only —
+        residency happens at :meth:`acquire`). Exactly one of ``regex`` /
+        ``json_schema``. Raises :class:`GrammarCompileError` on a bad
+        pattern, an uncompletable grammar, or a DFA larger than the pool's
+        ``max_states``."""
+        if name in self._registry:
+            raise ValueError(f"grammar {name!r} already registered")
+        if (regex is None) == (json_schema is None):
+            raise ValueError(
+                "register takes exactly one of regex= / json_schema=")
+        dfa = compile_token_dfa(regex if regex is not None else "",
+                                self.token_strs, json_schema=json_schema)
+        if dfa.n_states > self.max_states:
+            raise GrammarCompileError(
+                f"grammar {name!r} compiles to {dfa.n_states} states, pool "
+                f"max_states is {self.max_states}")
+        view = self._host_slot_view(dfa)
+        self._registry[name] = {
+            "dfa": dfa, "view": view, "crc": self._crc(view),
+            # per-leaf wraparound-uint32 sums: the acquire-time integrity
+            # digest is computed ON DEVICE (a scalar reduce per leaf) so
+            # pinning a resident grammar never pulls the multi-MB tables
+            # back to the host — the full crc32 stays the registry-identity
+            # basis. uint32 on BOTH sides: jax truncates wider reduces
+            # without x64, so the host must wrap identically
+            "digest": {k: int(np.sum(view[k].astype(np.uint32),
+                                     dtype=np.uint32))
+                       for k in _LEAVES},
+        }
+        self.stats["compiles"] += 1
+        if self._m_compile is not None:
+            self._m_compile.observe(dfa.compile_ms)
+        self._note("grammar:compile", grammar=name, states=dfa.n_states,
+                   ms=dfa.compile_ms, min_tokens=dfa.min_tokens)
+        return dfa
+
+    def _host_slot_view(self, dfa: CompiledGrammar) -> Dict[str, np.ndarray]:
+        """The grammar rendered in the DEVICE slot's padded byte layout —
+        the basis the register-time and acquire-time checksums share.
+        Padding states carry an empty mask, self-loop next (never reached:
+        no transition leads to them) and infinite dist."""
+        S, V = self.max_states, self.vocab
+        nxt = np.zeros((S, V), np.int32)
+        # forbidden (−1) transitions are stored clamped to 0 on device (the
+        # scan's gather needs in-range indices; NEED < _INF is the
+        # allowed-mask authority)
+        nxt[: dfa.n_states] = np.clip(dfa.next, 0, None)
+        need = np.full((S, V), _INF, np.int32)
+        need[: dfa.n_states] = dfa.dist_next
+        term = np.zeros((S,), bool)
+        term[: dfa.n_states] = dfa.terminal
+        return {"need": need, "next": nxt, "terminal": term}
+
+    @staticmethod
+    def _crc(view: Dict[str, np.ndarray]) -> int:
+        crc = 0
+        for k in _LEAVES:
+            crc = zlib.crc32(np.ascontiguousarray(view[k]).tobytes(), crc)
+        return crc
+
+    def _device_slot_view(self, slot: int) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(self.tree[k][slot]) for k in _LEAVES}
+
+    def _device_digest(self, slot: int) -> Dict[str, int]:
+        """Per-leaf int64 sums of the DEVICE slot — the acquire-time
+        integrity check. The reduce runs on device and only scalars cross
+        to the host (~μs), vs the ~tens-of-ms full-table pull a byte-wise
+        crc would cost per pin. Any single garbled entry moves the sum;
+        like any checksum it is a detection seam, not cryptography."""
+        return {k: int(jnp.sum(self.tree[k][slot].astype(jnp.uint32),
+                               dtype=jnp.uint32))
+                for k in _LEAVES}
+
+    def _write_slot(self, slot: int, entry: dict) -> None:
+        view = entry["view"]
+        self.tree = {
+            k: self.tree[k].at[slot].set(
+                jnp.asarray(view[k], self.tree[k].dtype))
+            for k in _LEAVES}
+
+    def _garble_slot(self, slot: int) -> None:
+        """Physically corrupt one device entry of the slot's mask table
+        (the ``grammar`` fault seam's 'corrupt' verdict) — the acquire-time
+        checksum must catch it; the repair rewrites from the registry. A
+        corrupted mask is exactly the failure that would emit an
+        out-of-grammar token, which must never happen."""
+        self.tree = dict(self.tree)
+        self.tree["need"] = self.tree["need"].at[slot, 0, 0].add(104729)
+        self.tree["next"] = self.tree["next"].at[slot, 0, 0].add(7)
+
+    # --- residency / pinning --------------------------------------------
+
+    def _evict_one(self) -> Optional[str]:
+        victims = [n for n, s in self.resident.items()
+                   if self.allocator.refcount[s] == 1]
+        if not victims:
+            return None
+        name = min(victims, key=lambda n: self._last_used.get(n, 0))
+        slot = self.resident.pop(name)
+        self.allocator.release([slot])
+        self._last_used.pop(name, None)
+        self.stats["evictions"] += 1
+        self._note("grammar:evict", grammar=name, slot=int(slot))
+        return name
+
+    def acquire(self, name: str) -> int:
+        """Make ``name`` device-resident (loading/evicting as needed),
+        checksum-verify the device tables against the registry (repairing
+        a corrupted slot in place), and take one pin. Returns the slot
+        index the request's ``grammar_idx`` entry should carry. Raises
+        :class:`GrammarPoolExhausted` (pool full, nothing evictable) or
+        :class:`GrammarLoadError` (injected load fault — retryable)."""
+        entry = self._registry.get(name)
+        if entry is None:
+            raise ValueError(f"unknown grammar {name!r} (register first)")
+        verdict = self.fault_hook() if self.fault_hook is not None else None
+        if verdict == "fail":
+            self.stats["load_failures"] += 1
+            self._note("grammar:load_fail", grammar=name)
+            raise GrammarLoadError(f"injected load failure for {name!r}")
+        self._clock += 1
+        slot = self.resident.get(name)
+        loaded = False
+        if slot is None:
+            t0 = time.perf_counter()
+            pages = self.allocator.alloc(1)
+            if pages is None:
+                self._evict_one()
+                pages = self.allocator.alloc(1)
+            if pages is None:
+                raise GrammarPoolExhausted(
+                    f"all {self.n_slots - 1} grammar slots pinned; "
+                    f"cannot load {name!r}")
+            slot = pages[0]
+            self._write_slot(slot, entry)
+            self.resident[name] = slot
+            self.stats["loads"] += 1
+            self.stats["resident_peak"] = max(self.stats["resident_peak"],
+                                              self.in_use())
+            loaded = True
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if self._m_load is not None:
+                self._m_load.observe(dt_ms)
+            self._note("grammar:load", grammar=name, slot=int(slot),
+                       ms=round(dt_ms, 3))
+        else:
+            self.stats["hits"] += 1
+        if verdict == "corrupt":
+            self._garble_slot(slot)
+        if self._device_digest(slot) != entry["digest"]:
+            # corrupted device tables: the registry copy is authoritative —
+            # rewrite in place (never an out-of-grammar token)
+            self._write_slot(slot, entry)
+            self.stats["repairs"] += 1
+            self._note("grammar:repair", grammar=name, slot=int(slot))
+        self._last_used[name] = self._clock
+        self.allocator.retain([slot])
+        self.stats["pins"] += 1
+        self._note("grammar:pin", grammar=name, slot=int(slot), loaded=loaded)
+        return int(slot)
+
+    def release(self, name: str) -> None:
+        """Drop one pin. The grammar STAYS resident (refcount 1 — the
+        residency hold) until LRU eviction needs its slot."""
+        slot = self.resident.get(name)
+        if slot is None:
+            return
+        self.allocator.release([slot])
+        self.stats["releases"] += 1
+
+    def evict(self, name: str) -> bool:
+        """Explicitly drop an UNPINNED resident grammar (ops/testing seam);
+        False when absent or pinned."""
+        slot = self.resident.get(name)
+        if slot is None or self.allocator.refcount[slot] != 1:
+            return False
+        self.resident.pop(name)
+        self.allocator.release([slot])
+        self._last_used.pop(name, None)
+        self.stats["evictions"] += 1
+        self._note("grammar:evict", grammar=name, slot=int(slot))
+        return True

@@ -275,6 +275,7 @@ class Router:
         self.autoscaler = autoscaler
         self._first_place_block: Dict[int, int] = {}
         self._adapter_registry: Dict[str, Tuple] = {}
+        self._grammar_registry: Dict[str, dict] = {}
         self.last_spawn: Dict[str, object] = {}
         self.stats = {
             "placements": 0, "affinity_placements": 0, "requeues": 0,
@@ -368,6 +369,8 @@ class Router:
             self.lm, self.snapshots[i],
             adapters=(dict(self._adapter_registry)
                       if self._adapter_registry else None),
+            grammars=(dict(self._grammar_registry)
+                      if self._grammar_registry else None),
             name=f"replica{i}", tracer=self.tracer, faults=self._injector,
             **self._spawn_overrides(self.role_of(i)))
         self.engines[i] = eng
@@ -390,6 +393,8 @@ class Router:
                           tracer=self.tracer, faults=self._injector, **kw)
         for name, (lp, lc) in self._adapter_registry.items():
             eng.register_adapter(name, lp, lc)
+        for name, spec in self._grammar_registry.items():
+            eng.register_grammar(name, **spec)
         self.engines.append(eng)
         self._alive.append(True)
         self._hb.append(self.blocks)
@@ -456,12 +461,26 @@ class Router:
         for eng in self.engines:
             eng.register_adapter(name, lora_params, lora_config)
 
+    def register_grammar(self, name: str, regex=None,
+                         json_schema=None) -> None:
+        """Register a grammar fleet-wide (every replica's pool compiles
+        and stores the token DFA; device residency stays per-replica).
+        The registry is retained so replicas the autoscaler spawns later
+        learn the same grammars, and failed-over constrained streams can
+        re-pin wherever they land."""
+        spec = ({"regex": regex} if regex is not None
+                else {"json_schema": json_schema})
+        self._grammar_registry[name] = spec
+        for eng in self.engines:
+            eng.register_grammar(name, **spec)
+
     def submit(self, prompt, max_new_tokens: int, *,
                tenant: str = "default", sampler=None,
                eos_token_id: Optional[int] = None, arrival_block: int = 0,
                ttft_deadline_ms: Optional[float] = None,
                deadline_ms: Optional[float] = None,
-               adapter: Optional[str] = None) -> Union[int, Rejected]:
+               adapter: Optional[str] = None,
+               grammar: Optional[str] = None) -> Union[int, Rejected]:
         """Queue a request with the router (placement happens at block
         boundaries); returns its globally-unique id, or a structured
         :class:`Rejected` when tenant-aware shedding refuses it. Deadlines
@@ -472,6 +491,7 @@ class Router:
         prompt, sampler, greedy = probe._validate_submit(
             prompt, max_new_tokens, sampler)
         probe._validate_adapter(adapter)
+        probe._validate_grammar(grammar, int(max_new_tokens))
         rid = self._next_id
         self._next_id += 1
         req = Request(
@@ -486,6 +506,7 @@ class Router:
                 arrival_block, deadline_ms, "deadline_ms"),
             tenant=str(tenant),
             adapter=adapter,
+            grammar=grammar,
         )
         t = self._tenant(req.tenant)
         t.submitted += 1
@@ -1089,7 +1110,8 @@ def run_router_trace(router: Router, trace,
                       ttft_deadline_ms=item.get("ttft_deadline_ms"),
                       deadline_ms=item.get("deadline_ms"),
                       tenant=item.get("tenant", "default"),
-                      adapter=item.get("adapter"))
+                      adapter=item.get("adapter"),
+                      grammar=item.get("grammar"))
         meta.append((item.get("tenant", "default"),
                      bool(item.get("deadline_ms")
                           or item.get("ttft_deadline_ms"))))

@@ -82,10 +82,20 @@ class SlotSampler:
     top_p: Optional[float] = None
 
     def __call__(self, logits: jax.Array, key: jax.Array,
-                 temperature: jax.Array, greedy: jax.Array) -> jax.Array:
+                 temperature: jax.Array, greedy: jax.Array,
+                 allowed: Optional[jax.Array] = None) -> jax.Array:
         """logits (b, vocab), key () or (b,) typed keys, temperature (b,)
-        f32, greedy (b,) bool -> (b,)."""
+        f32, greedy (b,) bool -> (b,).
+
+        ``allowed`` (b, vocab) bool is the structured-decoding support mask
+        (inference/grammar.py): disallowed logits are floored to −1e30
+        BEFORE the greedy/categorical split, so both branches sample inside
+        the grammar. An all-True row (the identity grammar, slot 0) leaves
+        its logits bit-for-bit untouched — what makes unconstrained rows in
+        a mixed pool identical to a pool with no grammar support."""
         logits = logits.astype(jnp.float32)
+        if allowed is not None:
+            logits = jnp.where(allowed, logits, -1e30)
         arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # temperature 0 rows route to argmax; the guard only keeps the
         # sampled branch finite for them (its result is discarded)

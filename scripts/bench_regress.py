@@ -78,6 +78,14 @@ RULES: List[Tuple[str, str, float]] = [
     (r"serve_itl_p(50|99)_ms_disagg", "lower", 0.15),
     (r"serve_decode_stall_ms_longprompt_disagg", "lower", 0.15),
     (r"serve_handoff_adopt_ms.*", "lower", 0.15),
+    # structured decoding (ISSUE 13): the parse rate is a CORRECTNESS key
+    # (must be 1.0 — zero tolerance, any drop is a masking bug, not
+    # noise); the structured-vs-freeform ITL ratio is higher-better (the
+    # in-scan mask must not stall the pool); grammar compile is a one-time
+    # host cost, noisy on a shared box
+    (r"serve_structured_parse_rate", "higher", 0.0),
+    (r"serve_itl_p50_ms_structured_vs_freeform", "higher", 0.10),
+    (r"grammar_compile_ms", "lower", 0.50),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better

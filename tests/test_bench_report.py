@@ -91,6 +91,13 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "adapter_switch_overhead_ms": 3.4,
                                       "adapter_acquire_hit_ms": 0.2,
                                       "adapter_bytes_per_slot": 13371392,
+                                      "serve_structured_parse_rate": 1.0,
+                                      "serve_itl_p50_ms_structured_vs_freeform": 0.981,
+                                      "grammar_compile_ms": 412.5,
+                                      "serve_itl_p50_ms_structured": 6.4,
+                                      "serve_itl_p50_ms_freeform": 6.28,
+                                      "serve_structured_requests": 6,
+                                      "grammar_bytes_per_slot": 15360000,
                                       "serve_tracing_overhead_ratio": 0.993,
                                       "serve_tokens_per_sec_traced": 508.4,
                                       "serve_tokens_per_sec_untraced": 512.0,
@@ -217,6 +224,19 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["adapter_switch_overhead_ms"] > d["adapter_acquire_hit_ms"]
     assert "serve_tokens_per_sec_merged_single" not in h
     assert "adapter_bytes_per_slot" not in h
+    # structured-decoding keys (ISSUE 13): the parse rate is a correctness
+    # gate (exactly 1.0 — every constrained completion parses), the
+    # structured-vs-freeform ITL ratio must clear the 0.9 no-stall gate,
+    # and the one-time DFA compile cost rides the headline; the raw split
+    # ITLs and pool sizing unit stay sidecar-only
+    assert d["serve_structured_parse_rate"] == \
+        h["serve_structured_parse_rate"] == 1.0
+    assert h["serve_itl_p50_ms_structured_vs_freeform"] >= 0.9
+    assert h["grammar_compile_ms"] == 412.5
+    assert "serve_itl_p50_ms_structured" not in h
+    assert "serve_itl_p50_ms_freeform" not in h
+    assert "grammar_bytes_per_slot" not in h
+    assert d["serve_structured_requests"] == 6
     # observability keys (ISSUE 6): the tracing-overhead ratio rides the
     # headline and must clear the zero-cost gate; the per-program compile
     # timing dict is sidecar-only (long keys stay out of the tail capture)
@@ -520,6 +540,40 @@ def test_bench_regress_autoscale_direction_rules(tmp_path):
     assert summary["regressions"][0]["direction"] == "lower"
     rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "better.json")
     assert rc == 0 and summary["counts"]["improved"] == 2
+
+
+def test_bench_regress_structured_direction_rules(tmp_path):
+    """Direction-of-goodness for the structured-decoding keys: the parse
+    rate is a zero-tolerance correctness gate (ANY drop from 1.0
+    regresses), a falling structured-vs-freeform ITL ratio regresses
+    beyond its 10% tolerance, and the one-time grammar compile cost is
+    lower-better with a wide host-noise tolerance."""
+    keys = ["serve_structured_parse_rate",
+            "serve_itl_p50_ms_structured_vs_freeform", "grammar_compile_ms"]
+    base = {"headline_keys": keys, "serve_structured_parse_rate": 1.0,
+            "serve_itl_p50_ms_structured_vs_freeform": 0.98,
+            "grammar_compile_ms": 400.0}
+    unparsed = dict(base, serve_structured_parse_rate=0.99)
+    stalled = dict(base, serve_itl_p50_ms_structured_vs_freeform=0.7)
+    better = {"headline_keys": keys, "serve_structured_parse_rate": 1.0,
+              "serve_itl_p50_ms_structured_vs_freeform": 1.02,
+              "grammar_compile_ms": 300.0}
+    for name, doc in (("base", base), ("unparsed", unparsed),
+                      ("stalled", stalled), ("better", better)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "unparsed.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "serve_structured_parse_rate"
+    assert summary["regressions"][0]["direction"] == "higher"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "stalled.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_itl_p50_ms_structured_vs_freeform"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "better.json")
+    assert rc == 0 and summary["counts"].get("regressed", 0) == 0
 
 
 def test_bench_regress_disagg_direction_rules(tmp_path):

@@ -351,6 +351,40 @@ def test_inference_runner_serve_multilora_tiny(capsys):
     assert report["adapter_bytes_per_slot"] > 0
 
 
+def test_inference_runner_serve_structured_tiny(capsys):
+    """ISSUE 13 CI gate: runner.py serve --grammar_frac drives structured
+    decoding through the CLI — 3 demo grammars (int regex, JSON-schema
+    object, call shape) churn through a 2-usable-slot pool (identity + 2),
+    every constrained completion ends in grammar_accept or budget (never a
+    non-parsing stream — asserted via the finish-reason split), the decode
+    host-op contract stays at 2.0 with grammars active, and the report
+    carries the structured surface."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--max_batch", "2", "--num_requests", "6",
+                 "--max_new_tokens", "32", "--fused_steps", "4",
+                 "--grammar_frac", "0.75", "--grammars", "3",
+                 "--grammar_pool_slots", "3",
+                 "--mean_interarrival", "2.0"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    s = report["structured"]
+    assert report["requests_completed"] == 6
+    assert report["host_ops_per_block"] == 2.0   # decode contract untouched
+    assert s["constrained_requests"] >= 2
+    assert s["grammar_slots"] == 3
+    assert s["grammar_loads"] >= 3               # all 3 grammars served
+    assert s["grammar_evictions"] >= 1           # pool churn happened
+    assert s["grammar_rejects"] == 0
+    # every stream ended cleanly: constrained ones in grammar_accept (or
+    # budget, which the budget-aware mask guarantees still parses)
+    assert set(s["finish_reasons"]) <= {"grammar_accept", "budget", "eos"}
+    assert s["finish_reasons"].get("grammar_accept", 0) >= 1
+    assert s["constrained"]["itl_p50_ms"] is not None
+    assert s["freeform"]["requests"] + s["constrained_requests"] == 6
+    assert s["grammar_bytes_per_slot"] > 0
+    assert max(s["grammar_compile_ms"].values()) > 0
+
+
 def test_inference_runner_serve_autoscale_tiny(capsys, tmp_path):
     """ISSUE 12 CI gate: runner.py serve --autoscale drives the elastic
     fleet through the CLI on a bursty trace — a cold scale-up during the

@@ -119,6 +119,40 @@ def test_session_eos_retires_and_slot_is_reused(lm):
         assert eng.stats["inserted_requests"] == 4 > lm.max_batch
 
 
+def test_completion_finish_reason_pinned(lm):
+    """ISSUE 13 satellite: ``Completion.finish_reason`` names why a stream
+    ended — callers previously inferred it by diffing fields. Pins "eos",
+    "budget", "expired" and "cancelled" on one engine (the
+    "grammar_accept" value is pinned in tests/test_structured.py), and
+    that fused and stepwise agree on the reason."""
+    p = _prompts(4, seed=11)
+    g0 = lm.generate(p[0:1], max_new_tokens=9)
+    eos = int(g0.tokens[0, 3])
+    submits = [dict(prompt=p[0], max_new_tokens=9, eos_token_id=eos),
+               dict(prompt=p[1], max_new_tokens=4),
+               dict(prompt=p[2], max_new_tokens=40, deadline_ms=6.0)]
+    reasons = {}
+    for fused in (True, False):
+        eng = ServeEngine(lm, block_steps=K, fused=fused,
+                          rng=jax.random.key(42))
+        ids = [eng.submit(**kw) for kw in submits]
+        eng.run(max_blocks=1)
+        comps = {c.request_id: c for c in eng.run()}
+        reasons[fused] = {r: comps[r].finish_reason for r in ids}
+        assert comps[ids[0]].finish_reason == "eos"
+        assert comps[ids[1]].finish_reason == "budget"
+        assert comps[ids[2]].finish_reason == "expired"
+        assert comps[ids[2]].expired
+    assert reasons[True] == reasons[False]
+    # cancelled: a fresh decoding stream cancelled mid-flight
+    eng = ServeEngine(lm, block_steps=K, rng=jax.random.key(42))
+    rid = eng.submit(p[3], 30)
+    eng.run(max_blocks=1)
+    assert eng.cancel(rid)
+    comp = next(c for c in eng.completed if c.request_id == rid)
+    assert comp.finish_reason == "cancelled" and comp.cancelled
+
+
 def test_session_fused_dispatch_count(lm):
     """The dispatch contract, counted three independent ways ON THE SAME
     RUN — tracer dispatch spans (the observability surface), a monkeypatch

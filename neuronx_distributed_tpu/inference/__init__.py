@@ -23,6 +23,11 @@ from neuronx_distributed_tpu.inference.engine import (  # noqa: F401
     synthetic_trace,
     synthetic_trace_stream,
 )
+from neuronx_distributed_tpu.inference.schedq import (  # noqa: F401
+    AdmissionQueue,
+    PendingQueue,
+)
+from neuronx_distributed_tpu.inference.simlm import SimCausalLM  # noqa: F401
 from neuronx_distributed_tpu.inference.grammar import (  # noqa: F401
     CompiledGrammar,
     GrammarCompileError,

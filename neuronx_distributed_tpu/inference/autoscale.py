@@ -148,6 +148,7 @@ class Autoscaler:
         # reason, warm) — NO wall quantities (those ride the router's
         # stats/metrics) so replay comparisons are exact
         self.scale_events: List[dict] = []
+        self._unresolved_ups: set = set()
         self._over: Dict[str, int] = {}
         self._idle: Dict[str, int] = {}
         self._last_event: Dict[str, int] = {}
@@ -172,15 +173,21 @@ class Autoscaler:
 
     def _signals(self, router, role: str, live: List[int]) -> _Signals:
         pol = self.policy_for(role)
-        loads = [router.engines[i].load_summary() for i in live]
+        # per-block CACHED load summaries (router._rload — refreshed once
+        # after each engine steps) instead of a fresh O(slots + trie)
+        # load_summary() per replica per block, and the router queue's
+        # incremental per-(role, tenant) integer cost sums instead of a
+        # full backlog scan (ROADMAP #18: the PR 12 remainder — policy
+        # signal reads no longer scale with fleet-wide in-flight count)
+        loads = [router._rload[i] for i in live]
         eng0 = router.engines[live[0]] if live else router.engines[0]
         slots = eng0.lm.max_batch
         rate = slots * eng0.block_steps          # tokens per replica-block
-        arrived = [e for e in router.pending if router._arrived(e)
-                   and (role == "both" or self._entry_role(e) == role)]
-        w_tokens = sum(router._cost(e.req)
-                       / router._tenant(e.req.tenant).weight
-                       for e in arrived)
+        router.pending.advance(router.blocks)
+        cost = router.pending.role_tenant_cost(role)
+        w_tokens = sum(c / router._tenant(t).weight
+                       for t, c in sorted(cost.items()))
+        arrived_n = router.pending.ready_count(router.blocks, role)
         extra_slots = 0
         if role == "decode":
             # handoffs the decode pool could not adopt are decode backlog
@@ -200,7 +207,7 @@ class Autoscaler:
         # them again would read a prefill-heavy fleet as >100% busy)
         busy = (sum(l.active_slots + l.queue_depth + l.replays
                     for l in loads)
-                + len(arrived) + extra_slots)
+                + arrived_n + extra_slots)
         utilization = busy / float(n * slots)
         up = None
         if slo and pol.slo_scale_up:
@@ -257,7 +264,7 @@ class Autoscaler:
         elif (cooled and not draining_role
                 and self._idle[role] >= pol.down_patience_blocks
                 and len(live) > pol.min_replicas):
-            loads = {i: router.engines[i].load_summary() for i in live}
+            loads = {i: router._rload[i] for i in live}
             victim = min(live, key=lambda i: (
                 loads[i].active_slots + loads[i].backlog, -i))
             self._scale_down(router, role, victim)
@@ -284,6 +291,8 @@ class Autoscaler:
             "replica": int(victim), "reason": "idle", "warm": None})
 
     def _note(self, router, ev: dict) -> None:
+        if ev["action"] == "up":
+            self._unresolved_ups.add(len(self.scale_events))
         self.scale_events.append(ev)
         router.metrics.counter(
             "router_scale_events_total", help="autoscaler fleet mutations",
@@ -301,12 +310,12 @@ class Autoscaler:
     # --- reporting --------------------------------------------------------
 
     def _resolve_ttr(self, router) -> None:
-        for idx, ev in enumerate(self.scale_events):
-            if ev["action"] != "up" or idx in self._ttr:
-                continue
+        for idx in sorted(self._unresolved_ups):
+            ev = self.scale_events[idx]
             fp = router._first_place_block.get(ev["replica"])
             if fp is not None and fp >= ev["block"]:
                 self._ttr[idx] = int(fp) - int(ev["block"])
+                self._unresolved_ups.discard(idx)
 
     def time_to_ready_blocks(self, router) -> List[int]:
         """Per scale-up event: blocks from the decision to the new

@@ -237,7 +237,7 @@ class DisaggRouter(Router):
         everything else — fresh admissions AND replays that never produced
         a token — is prefill work."""
         want = "decode" if (e.replay and e.generated) else "prefill"
-        return [i for i in self._live_replicas()
+        return [i for i in sorted(self._open)
                 if self.role_of(i) == want and self._can_take(i, e.req)]
 
     def _place(self) -> None:
@@ -251,17 +251,17 @@ class DisaggRouter(Router):
 
     # --- failure ----------------------------------------------------------
 
-    def _failover(self, i: int) -> None:
-        """Role-aware failover: a handoff already pumped to the router is
-        SAFE (the bytes live in host memory, source-independent) and keeps
-        flowing; requests that died on the replica itself replay — with
-        zero delivered tokens they are plain prefill work again, so the
-        entries are flipped back to fresh placements (a prefill worker
-        cannot resume a decode stream)."""
-        super()._failover(i)
-        for e in self.pending:
-            if e.replay and not e.generated:
-                e.replay = False
+    def _make_replay_entry(self, rec, gen):
+        """Role-aware failover re-entry: a handoff already pumped to the
+        router is SAFE (the bytes live in host memory, source-independent)
+        and keeps flowing; a request that died on the replica itself with
+        ZERO delivered tokens is plain prefill work again — the entry is
+        built as a fresh placement (a prefill worker cannot resume a
+        decode stream)."""
+        e = super()._make_replay_entry(rec, gen)
+        if not gen:
+            e.replay = False
+        return e
 
     # --- the handoff pump -------------------------------------------------
 
@@ -273,6 +273,8 @@ class DisaggRouter(Router):
         live = self._live_decode()
         j = min(live, key=lambda j: self._load_score(j, h.req))
         self.engines[j].resume(h.req, [h.first_token])
+        self._refresh_load(j)
+        self._note_affinity(h.req, j)
         rec = self._records.get(h.req.request_id)
         if rec is not None:
             rec.replica = j
@@ -348,6 +350,8 @@ class DisaggRouter(Router):
                     rec.delivered = [h.first_token]
                     self._decode_home[h.req.request_id] = j
                     self.stats["handoffs_adopted"] += 1
+                    self._refresh_load(j)
+                    self._note_affinity(h.req, j)
                     placed = True
                     break
                 if out == "degraded":
@@ -376,13 +380,13 @@ class DisaggRouter(Router):
                     f"decode worker dead or drained")
             return True
         if (self.pending and not self._dark and not self._draining):
-            fresh = [e for e in self.pending
-                     if not (e.replay and e.generated)]
+            fresh = self.pending.fresh_count()
             if fresh and not self._live_prefill():
                 raise NoLiveReplicas(
-                    f"{len(fresh)} requests pending with every prefill "
+                    f"{fresh} requests pending with every prefill "
                     f"worker dead or drained")
-            if len(fresh) < len(self.pending) and not self._live_decode():
+            if (self.pending.decode_replay_count()
+                    and not self._live_decode()):
                 raise NoLiveReplicas(
                     "mid-stream replays pending with every decode worker "
                     "dead or drained")

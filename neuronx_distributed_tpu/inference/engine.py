@@ -137,6 +137,11 @@ from neuronx_distributed_tpu.inference.paged_cache import (
     PagePoolExhausted,
 )
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
+from neuronx_distributed_tpu.inference.schedq import (
+    AdmissionQueue,
+    admission_deadline,
+    shed_deadline_key,
+)
 
 
 @dataclasses.dataclass
@@ -249,6 +254,11 @@ class ReplicaLoad:
     slo_alerting: bool = False       # any burn rule latched right now
     decode_blocks: int = 0
     inserted_requests: int = 0
+    # undelivered token budgets (ROADMAP #18): the router's fleet-wide
+    # retry-after estimate reads these off the per-block cached summary
+    # instead of re-scanning every replica's slots and queue per shed
+    inflight_tokens: int = 0         # sum over live slots of remaining budget
+    queued_tokens: int = 0           # sum over queued requests' budgets
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -281,6 +291,12 @@ _STAT_KEYS = (
     "adapter_rejects", "adapter_load_retries",
     "grammar_rejects", "grammar_load_retries",
     "handoffs_sent", "handoffs_adopted",
+    # streaming-report aggregates (ROADMAP #18): the memory-bounded trace
+    # drivers (keep_completions=False) read the whole completion surface
+    # from these counters + the latency histograms instead of materialized
+    # per-request Completion lists
+    "completed", "generated_tokens", "ontime_tokens", "deadline_misses",
+    "queue_blocks_sum", "ttft_blocks_sum",
 )
 
 
@@ -377,6 +393,7 @@ class ServeEngine:
         incident_burst_threshold: int = 3,
         incident_burst_window: int = 8,
         role: str = "both",
+        keep_completions: bool = True,
     ):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(
@@ -415,6 +432,14 @@ class ServeEngine:
                 "host_tier_pages requires prefix_cache=True (the tier "
                 "retains radix entries — without the index there is "
                 "nothing to mark tiered)")
+        # host-only scheduler simulation (inference/simlm.py): a stub lm
+        # whose insert/decode programs are zero-cost host no-ops with the
+        # same slot/page accounting — million-request soaks never execute
+        # XLA. The engine routes its sampling sites through the stub's
+        # deterministic token function instead of jax.
+        self._sim = bool(getattr(lm, "sim", False))
+        if self._sim and host_tier_pages:
+            raise ValueError("sim engines have no device pages to tier")
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
@@ -503,7 +528,10 @@ class ServeEngine:
         self._submit_ts: Dict[int, float] = {}
         self._last_tok_ts: Dict[int, float] = {}
         # base key: request r's token t draws from fold_in(fold_in(rng, r), t)
-        self.rng = rng if rng is not None else jax.random.key(0)
+        # (sim engines never sample — the stub's token function replaces
+        # the whole rng surface, and the hot path stays jax-free)
+        self.rng = (None if self._sim
+                    else rng if rng is not None else jax.random.key(0))
         if lm._decode is None:
             lm.compile()
         self.session = lm.start_session()
@@ -525,16 +553,29 @@ class ServeEngine:
                 self.session.paged.tier.fault_hook = \
                     self._injector.on_tier_restore
         b = lm.max_batch
-        self.queue: deque[Request] = deque()
+        # heap-backed admission backlog (inference/schedq.py): EDF order,
+        # shed victims, queued-deadline expiry and the arrived/token
+        # counters are all O(log n) / O(1) instead of per-block re-sorts
+        # and linear scans (ROADMAP #18)
+        self.queue: AdmissionQueue = AdmissionQueue()
         self.slots: List[Optional[Request]] = [None] * b
         self._out: Dict[int, List[int]] = {}
         self._out_ts: Dict[int, List[float]] = {}
+        # keep_completions=False bounds host memory on long soaks: finished
+        # streams fold into the stats counters + latency histograms (the
+        # streaming-report surface) instead of growing this list
+        self.keep_completions = bool(keep_completions)
         self.completed: List[Completion] = []
         self.rejected: List[Rejected] = []
+        # request ids that received tokens THIS block — the router's
+        # delivery-record refresh reads only these instead of rebuilding
+        # every in-flight stream's record per block (ISSUE 14 satellite)
+        self._emitted: set = set()
         # in-flight recovery work: (request, generated-so-far, token stamps)
         # awaiting a replay re-prefill (crash restore / corrupted-page
         # recovery); drained before admission each block
         self._replay_q: deque[Tuple[Request, List[int], List[float]]] = deque()
+        self._replay_tokens = 0     # sum max_new_tokens over _replay_q
         # host mirrors of the on-device per-slot state (exact by design:
         # every device latch is a pure function of the fetched emissions)
         self._lengths = np.zeros((b,), np.int32)
@@ -546,7 +587,8 @@ class ServeEngine:
         self._tok = np.zeros((b,), np.int32)
         # per-slot request keys + generated-token counters (the device
         # samples row j's step under fold_in(slot_keys[j], counts[j]))
-        self._slot_keys = jax.random.split(self.rng, b)
+        self._slot_keys = (None if self._sim
+                           else jax.random.split(self.rng, b))
         self._gen_counts = np.zeros((b,), np.int32)
         # chunked-prefill state: slot -> in-flight admission, FIFO order
         self._prefilling: Dict[int, _PrefillInFlight] = {}
@@ -784,8 +826,7 @@ class ServeEngine:
         # read off the oldest decoding stream's remaining budget — the
         # earliest retirement that returns pages).
         if self.max_queue is not None and req.arrival_block <= self.blocks:
-            arrived = sum(1 for r in self.queue
-                          if r.arrival_block <= self.blocks)
+            arrived = self.queue.arrived_count(self.blocks)
             pool_bound = not self._pool_can_admit(req.prompt.size,
                                                   req.max_new_tokens)
             usable = 0 if pool_bound else len(self._free_slots())
@@ -801,25 +842,26 @@ class ServeEngine:
         back atomically, no completion; decoding → retired NOW with a
         partial (``cancelled=True``) completion. Returns False when the id
         is unknown or already completed."""
-        for i, r in enumerate(self.queue):
-            if r.request_id == request_id:
-                del self.queue[i]
-                self._release_adapter(r)
-                self._release_grammar(r)
-                self.stats["cancelled"] += 1
-                if self.tracer.enabled:
-                    self.tracer.instant("cancel", ("req", request_id),
-                                        block=self.blocks,
-                                        args={"state": "queued"})
-                return True
+        r = self.queue.find(request_id)
+        if r is not None:
+            self.queue.remove(request_id)
+            self._release_adapter(r)
+            self._release_grammar(r)
+            self.stats["cancelled"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant("cancel", ("req", request_id),
+                                    block=self.blocks,
+                                    args={"state": "queued"})
+            return True
         for i, (req, pregen, ts) in enumerate(self._replay_q):
             if req.request_id == request_id:
                 del self._replay_q[i]
+                self._replay_tokens -= req.max_new_tokens
                 # the client already HAS pregen tokens; the completion
                 # records them so accounting stays whole-stream
                 self._out[req.request_id] = list(pregen)
                 self._out_ts[req.request_id] = list(ts)
-                self.completed.append(self._completion_of(
+                self._emit_completion(self._completion_of(
                     req, cancelled=True))
                 self.stats["cancelled"] += 1
                 return True
@@ -1060,25 +1102,11 @@ class ServeEngine:
         return int(arrival_block) + max(
             1, int(np.ceil(float(ms) / self.block_time_ms)))
 
-    @staticmethod
-    def _admission_deadline(r: Request) -> float:
-        """EDF sort key: the binding deadline for getting ADMITTED — first
-        token (when set), else completion, else never."""
-        if r.ttft_deadline_block is not None:
-            return float(r.ttft_deadline_block)
-        if r.deadline_block is not None:
-            return float(r.deadline_block)
-        return float("inf")
-
-    @staticmethod
-    def _shed_key(r: Request):
-        """'deadline' shed policy victim ordering: laxest effective deadline
-        sheds first; deadline-free requests shed before any deadline'd one;
-        ties drop the newest submission."""
-        ttft = (float("inf") if r.ttft_deadline_block is None
-                else r.ttft_deadline_block)
-        full = float("inf") if r.deadline_block is None else r.deadline_block
-        return (min(ttft, full), r.request_id)
+    # EDF / shed victim orderings live in inference/schedq.py now (the
+    # heaps and the engine must share one definition); kept as staticmethod
+    # aliases for the tests and external callers that pinned them
+    _admission_deadline = staticmethod(admission_deadline)
+    _shed_key = staticmethod(shed_deadline_key)
 
     def _deadline_passed(self, r: Request) -> bool:
         return ((r.ttft_deadline_block is not None
@@ -1099,8 +1127,7 @@ class ServeEngine:
         (queued + replaying + in-flight remainders) over the pool's K*slots
         per-block service rate — what a shed client should wait before
         resubmitting."""
-        queued = sum(r.max_new_tokens for r in self.queue)
-        queued += sum(r.max_new_tokens for r, _g, _t in self._replay_q)
+        queued = self.queue.tokens() + self._replay_tokens
         inflight = sum(
             req.max_new_tokens - len(self._out.get(req.request_id, []))
             for req in self.slots if req is not None)
@@ -1183,11 +1210,10 @@ class ServeEngine:
         stream's remaining budget instead of the queue-drain rate."""
         victim = req
         if self.shed_policy == "deadline":
-            arrived = [r for r in self.queue
-                       if r.arrival_block <= self.blocks]
-            worst = max(arrived + [req], key=self._shed_key)
-            if worst is not req:
-                self.queue.remove(worst)
+            worst = self.queue.peek_lax_victim(self.blocks)
+            if (worst is not None
+                    and shed_deadline_key(worst) > shed_deadline_key(req)):
+                self.queue.remove(worst.request_id)
                 self.queue.append(req)
                 victim = worst
                 self.stats["shed_evictions"] += 1
@@ -1200,8 +1226,7 @@ class ServeEngine:
         self._release_grammar(victim)
         rej = Rejected(request_id=victim.request_id,
                        retry_after_blocks=retry,
-                       queue_depth=sum(1 for r in self.queue
-                                       if r.arrival_block <= self.blocks),
+                       queue_depth=self.queue.arrived_count(self.blocks),
                        reason="pool_exhausted" if pool_bound
                        else "queue_full")
         self.rejected.append(rej)
@@ -1226,28 +1251,28 @@ class ServeEngine:
             return
         limit = self.max_queue + len(self._free_slots())
         while True:
-            arrived = [r for r in self.queue
-                       if r.arrival_block <= self.blocks]
-            if len(arrived) <= limit:
+            arrived = self.queue.arrived_count(self.blocks)
+            if arrived <= limit:
                 return
             if self.shed_policy == "deadline":
-                victim = max(arrived, key=self._shed_key)
+                victim = self.queue.peek_lax_victim(self.blocks)
             else:
-                victim = max(arrived,
-                             key=lambda r: (r.arrival_block, r.request_id))
-            self.queue.remove(victim)
+                victim = self.queue.peek_tail_victim(self.blocks)
+            if victim is None:
+                return
+            self.queue.remove(victim.request_id)
             self._release_adapter(victim)
             self._release_grammar(victim)
             self.rejected.append(Rejected(
                 request_id=victim.request_id,
                 retry_after_blocks=self._retry_after(),
-                queue_depth=len(arrived) - 1))
+                queue_depth=arrived - 1))
             self.stats["rejected"] += 1
             if self.tracer.enabled:
                 self.tracer.instant(
                     "shed", ("req", victim.request_id), block=self.blocks,
                     args={"policy": self.shed_policy, "at": "block_boundary",
-                          "queue_depth": len(arrived) - 1})
+                          "queue_depth": arrived - 1})
 
     def _dispatch(self, kind: str, fn):
         """Run one compiled-program dispatch with transient-failure
@@ -1345,10 +1370,26 @@ class ServeEngine:
             finish_reason=reason,
         )
 
+    def _emit_completion(self, comp: Completion) -> None:
+        """Single exit point for finished streams: folds the completion
+        into the aggregate counters (the streaming report's source) and
+        retains the object only when ``keep_completions`` — a 1M-request
+        soak holds O(in-flight) completions instead of O(trace)."""
+        self.stats["completed"] += 1
+        self.stats["generated_tokens"] += len(comp.tokens)
+        self.stats["queue_blocks_sum"] += comp.queue_blocks
+        self.stats["ttft_blocks_sum"] += comp.ttft_blocks
+        if comp.deadline_missed:
+            self.stats["deadline_misses"] += 1
+        if not (comp.deadline_missed or comp.expired or comp.cancelled):
+            self.stats["ontime_tokens"] += len(comp.tokens)
+        if self.keep_completions:
+            self.completed.append(comp)
+
     def _complete_slot(self, slot: int, cancelled: bool = False,
                        expired: bool = False) -> None:
         req = self.slots[slot]
-        self.completed.append(self._completion_of(req, cancelled=cancelled,
+        self._emit_completion(self._completion_of(req, cancelled=cancelled,
                                                   expired=expired))
         self.slots[slot] = None
         self._active[slot] = False
@@ -1404,7 +1445,7 @@ class ServeEngine:
                 "expire", ("req", req.request_id), block=self.blocks,
                 args={"generated": 0, "state": "pre_decode",
                       "deadline_missed": True})
-        self.completed.append(Completion(
+        self._emit_completion(Completion(
             request_id=req.request_id, tokens=np.zeros((0,), np.int64),
             prompt_len=req.prompt.size,
             queue_blocks=max(self.blocks - req.arrival_block, 0),
@@ -1421,8 +1462,10 @@ class ServeEngine:
         self.stats["expired"] += 1
 
     def _expire_queued(self) -> None:
-        for r in [r for r in self.queue if self._deadline_passed(r)]:
-            self.queue.remove(r)
+        # O(log n) per expiry off the deadline heap (was a full queue scan
+        # per block); expire_due returns deque order, so multi-expiry
+        # blocks record completions in the historic order
+        for r in self.queue.expire_due(self.blocks):
             self._expire_request(r)
 
     def _expire_prefilling(self) -> None:
@@ -1450,16 +1493,6 @@ class ServeEngine:
         return bool(self.prefill_chunk_tokens
                     and req.prompt.size > self.prefill_chunk_tokens)
 
-    def _arrived_sorted(self) -> List[Request]:
-        """Arrived requests in admission order: earliest-deadline-first
-        (EDF — a request with a binding ttft/completion deadline jumps
-        ahead), deadline-free requests keep strict FIFO among themselves
-        (stable sort on queue position)."""
-        arrived = [(i, r) for i, r in enumerate(self.queue)
-                   if r.arrival_block <= self.blocks]
-        arrived.sort(key=lambda ir: (self._admission_deadline(ir[1]), ir[0]))
-        return [r for _, r in arrived]
-
     def _admit(self) -> None:
         """Admit arrived requests into free slots, batching prompts that
         share a prefill bucket into ONE right-sized insert. Admission order
@@ -1484,13 +1517,16 @@ class ServeEngine:
             free = self._free_slots()
             if not free:
                 return
-            order = [r for r in self._arrived_sorted()
-                     if r.request_id not in deferred]
+            # admission order off the EDF heap: only the first len(free)
+            # arrived candidates are ever inspected (group size is capped
+            # by free slots), so the scan is O(slots log n) instead of the
+            # old full-backlog re-sort per iteration
+            order = self.queue.peek_edf(self.blocks, deferred, len(free))
             if not order:
                 return
             head = order[0]
             if self._is_chunked(head):
-                self.queue.remove(head)
+                self.queue.remove(head.request_id)
                 if not self._acquire_adapter(head):
                     deferred.add(head.request_id)
                     continue
@@ -1507,7 +1543,7 @@ class ServeEngine:
                     break
                 group.append(r)
             for r in group:
-                self.queue.remove(r)
+                self.queue.remove(r.request_id)
             # (tenant, adapter)-keyed admission: each request's adapter is
             # loaded+pinned before any device work; a failed acquire drops
             # the request out of the group (shed or requeued) while its
@@ -1601,22 +1637,31 @@ class ServeEngine:
         self._note_tier_restore(group, tier_before)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += rows
-        # first token per inserted request: token index 0 of each request's
-        # own key stream (fold_in(req_key, 0) — the same derivation the
-        # chunked path's final chunk and both decode modes use)
-        keys = jnp.stack([self._req_key(r.request_id) for r in group])
-        sub = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((rows,), jnp.int32))
         temps = np.asarray([r.temperature for r in group], np.float32)
         greedy = np.asarray([r.greedy for r in group], bool)
-        # first tokens are constrained too: budget-aware mask from each
-        # grammar's START state, pre-applied host-side (no-op when the
-        # whole group is free-form — the sampler call and its compiled
-        # eager shapes stay byte-identical to a grammarless engine)
-        logits = self._mask_logits(
-            logits, self._grammar_allowed_rows(group, [0] * rows,
-                                               [0] * rows))
-        first = np.asarray(self.slot_sampler(
-            logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))
+        if self._sim:
+            # host-only simulation: the stub's deterministic token
+            # function replaces the whole jax sampling path (no XLA)
+            keys = None
+            first = np.asarray(self.lm.sim_first_tokens(
+                [r.request_id for r in group], [0] * rows), np.int64)
+        else:
+            # first token per inserted request: token index 0 of each
+            # request's own key stream (fold_in(req_key, 0) — the same
+            # derivation the chunked path's final chunk and both decode
+            # modes use)
+            keys = jnp.stack([self._req_key(r.request_id) for r in group])
+            sub = jax.vmap(jax.random.fold_in)(keys,
+                                               jnp.zeros((rows,), jnp.int32))
+            # first tokens are constrained too: budget-aware mask from each
+            # grammar's START state, pre-applied host-side (no-op when the
+            # whole group is free-form — the sampler call and its compiled
+            # eager shapes stay byte-identical to a grammarless engine)
+            logits = self._mask_logits(
+                logits, self._grammar_allowed_rows(group, [0] * rows,
+                                                   [0] * rows))
+            first = np.asarray(self.slot_sampler(
+                logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))
         now = time.perf_counter()
         for i, (r, slot) in enumerate(zip(group, slot_ids)):
             r.start_block = self.blocks
@@ -1633,7 +1678,8 @@ class ServeEngine:
             self._temp[slot] = temps[i]
             self._greedy[slot] = greedy[i]
             self._tok[slot] = int(first[i])
-            self._slot_keys = self._slot_keys.at[slot].set(keys[i])
+            if not self._sim:
+                self._slot_keys = self._slot_keys.at[slot].set(keys[i])
             self._gen_counts[slot] = 1
             self._adapter_idx[slot] = 0 if aslots is None else aslots[i]
             self._gidx[slot] = self._grammar_slot(r)
@@ -1674,8 +1720,9 @@ class ServeEngine:
         self.slots[slot] = req
         self._active[slot] = False
         self._done[slot] = False
-        self._slot_keys = self._slot_keys.at[slot].set(
-            self._req_key(req.request_id))
+        if not self._sim:
+            self._slot_keys = self._slot_keys.at[slot].set(
+                self._req_key(req.request_id))
         # chunk prefill must already run under the request's adapter — the
         # KV it writes is adapter-specific
         self._adapter_idx[slot] = self._adapter_slot(req)
@@ -1742,15 +1789,18 @@ class ServeEngine:
             self.session.paged.finish_chunked(slot, st.chunk)
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
-        key = self._req_key(req.request_id)
-        sub = jax.vmap(jax.random.fold_in)(key[None],
-                                           jnp.zeros((1,), jnp.int32))
         temps = np.asarray([req.temperature], np.float32)
         greedy = np.asarray([req.greedy], bool)
-        logits = self._mask_logits(
-            logits, self._grammar_allowed_rows([req], [0], [0]))
-        first = int(np.asarray(self.slot_sampler(
-            logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
+        if self._sim:
+            first = self.lm.sim_token(req.request_id, 0)
+        else:
+            key = self._req_key(req.request_id)
+            sub = jax.vmap(jax.random.fold_in)(key[None],
+                                               jnp.zeros((1,), jnp.int32))
+            logits = self._mask_logits(
+                logits, self._grammar_allowed_rows([req], [0], [0]))
+            first = int(np.asarray(self.slot_sampler(
+                logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
         req.first_token_block = self.blocks
         self._observe_first_token(req, slot, time.perf_counter(),
                                   chunked=True)
@@ -1785,8 +1835,9 @@ class ServeEngine:
         if st.chunk is not None:
             pkv = self.session.paged
             pkv.abort_chunked(slot, st.chunk)
-            self.session.cache = _set_block_tables(self.session.cache,
-                                                   pkv.tables)
+            if self.session.cache is not None:
+                self.session.cache = _set_block_tables(self.session.cache,
+                                                       pkv.tables)
         self.slots[slot] = None
         self._active[slot] = False
         self._adapter_idx[slot] = 0
@@ -1849,6 +1900,7 @@ class ServeEngine:
                         args={"grammar": req.grammar, "state": "replay"})
                 return
             self._replay_q.popleft()
+            self._replay_tokens -= req.max_new_tokens
 
     def _replay_admission(self, req: Request, pregen: List[int],
                           ts: List[float], slot: int) -> None:
@@ -1915,28 +1967,35 @@ class ServeEngine:
             # the request stays in the replay queue for the next attempt
             if pkv is not None:
                 pkv.abort_chunked(slot, st)
-                self.session.cache = _set_block_tables(self.session.cache,
-                                                       pkv.tables)
+                if self.session.cache is not None:
+                    self.session.cache = _set_block_tables(
+                        self.session.cache, pkv.tables)
             self.session.lengths[slot] = 0
             self.session.active[slot] = False
             raise
         if pkv is not None:
             pkv.finish_chunked(slot, st)
-        key = self._req_key(req.request_id)
-        sub = jax.vmap(jax.random.fold_in)(key[None],
-                                           jnp.full((1,), g, jnp.int32))
         temps = np.asarray([req.temperature], np.float32)
         greedy = np.asarray([req.greedy], bool)
-        # resumed constrained stream: the DFA state is a pure function of
-        # the delivered tokens — walk them, then mask token g exactly as
-        # the uninterrupted run would have (snapshot/failover carries the
-        # grammar NAME; the state is recomputed, so it cannot drift)
-        rstate = (self._grammar_walk(req.grammar, 0, pregen)
-                  if self.grammar and req.grammar is not None else 0)
-        logits = self._mask_logits(
-            logits, self._grammar_allowed_rows([req], [rstate], [g]))
-        tok = int(np.asarray(self.slot_sampler(
-            logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
+        rstate = 0
+        if self._sim:
+            key = None
+            tok = self.lm.sim_token(req.request_id, g)
+        else:
+            key = self._req_key(req.request_id)
+            sub = jax.vmap(jax.random.fold_in)(key[None],
+                                               jnp.full((1,), g, jnp.int32))
+            # resumed constrained stream: the DFA state is a pure function
+            # of the delivered tokens — walk them, then mask token g
+            # exactly as the uninterrupted run would have (snapshot/
+            # failover carries the grammar NAME; the state is recomputed,
+            # so it cannot drift)
+            rstate = (self._grammar_walk(req.grammar, 0, pregen)
+                      if self.grammar and req.grammar is not None else 0)
+            logits = self._mask_logits(
+                logits, self._grammar_allowed_rows([req], [rstate], [g]))
+            tok = int(np.asarray(self.slot_sampler(
+                logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
         now = time.perf_counter()
         if req.start_block is None:
             req.start_block = self.blocks
@@ -1953,7 +2012,8 @@ class ServeEngine:
         self._temp[slot] = temps[0]
         self._greedy[slot] = greedy[0]
         self._tok[slot] = tok
-        self._slot_keys = self._slot_keys.at[slot].set(key)
+        if not self._sim:
+            self._slot_keys = self._slot_keys.at[slot].set(key)
         self._gen_counts[slot] = g + 1
         self._adapter_idx[slot] = aslot
         self._gidx[slot] = gslot
@@ -2125,6 +2185,7 @@ class ServeEngine:
             self._done[slot] = False
             self._adapter_idx[slot] = 0   # the pin survives for the replay
             self._replay_q.append((req, pregen, ts))
+            self._replay_tokens += req.max_new_tokens
             self.stats["corrupt_page_replays"] += 1
             if self.tracer.enabled:
                 self.tracer.instant(
@@ -2333,6 +2394,7 @@ class ServeEngine:
         req.start_block = None
         req.first_token_block = None
         self._replay_q.append((req, [int(t) for t in generated], []))
+        self._replay_tokens += req.max_new_tokens
         return req.request_id
 
     def extract_queued(self) -> List[Request]:
@@ -2368,6 +2430,7 @@ class ServeEngine:
         (adapter pins released here, re-taken at the destination)."""
         out = [(req, list(gen)) for req, gen, _ts in self._replay_q]
         self._replay_q.clear()
+        self._replay_tokens = 0
         for req, _gen in out:
             self._release_adapter(req)
             self._release_grammar(req)
@@ -2388,6 +2451,10 @@ class ServeEngine:
         their streams were already delivered. Pair with
         :meth:`from_snapshot`; take it between blocks (``run`` does, via
         ``snapshot_path``)."""
+        if self._sim:
+            raise ValueError(
+                "sim engines have no rng/device state to snapshot")
+
         def enc(r: Request, state: str, generated: List[int]) -> dict:
             # constrained streams carry (grammar name, DFA state): the
             # state is recomputable from the generated tokens (and the
@@ -2432,7 +2499,7 @@ class ServeEngine:
                 reqs.append(enc(r, "decoding", self._out[r.request_id]))
         for req, pregen, _ts in self._replay_q:
             reqs.append(enc(req, "decoding", pregen))
-        for r in self.queue:
+        for r in self.queue.ordered():
             reqs.append(enc(r, "queued", []))
         return {
             "version": 1,
@@ -2532,6 +2599,7 @@ class ServeEngine:
             if rd["state"] == "decoding":
                 eng._replay_q.append(
                     (req, [int(t) for t in rd["generated"]], []))
+                eng._replay_tokens += req.max_new_tokens
             else:
                 # mid-prefill admissions restart from the queue (listed
                 # before queued entries, so they keep admission priority)
@@ -2553,6 +2621,7 @@ class ServeEngine:
         out = self._out[req.request_id]
         out.append(token)
         self._out_ts[req.request_id].append(ts)
+        self._emitted.add(req.request_id)
         # delivery-gap surface: tokens of one fused fetch share a stamp, so
         # only cross-delivery gaps (ts advanced) are observed — the user-
         # experienced inter-token latency, same filter run_trace applies
@@ -2587,7 +2656,7 @@ class ServeEngine:
         """Per-block level sampling (host-side, one call per scheduling
         round): arrived backlog depth and — in paged mode — page-pool
         occupancy, as gauges plus Perfetto counter tracks when tracing."""
-        depth = sum(1 for r in self.queue if r.arrival_block <= self.blocks)
+        depth = self.queue.arrived_count(self.blocks)
         self._m_queue.set(depth)
         self._m_dropped.set(self.tracer.dropped)
         tr_on = self.tracer.enabled
@@ -2672,6 +2741,8 @@ class ServeEngine:
         ``block_steps`` tokens, record emissions, expire past-deadline
         streams, retire finished slots. Returns False when there is nothing
         left to do at the current virtual time."""
+        self._emitted.clear()     # harvest reads last block's emissions
+        self.queue.advance(self.blocks)
         self._drain_replays()     # recovery work re-enters ahead of admits
         self._admit()
         self._retire_finished()   # a 1-token budget finishes at insert time
@@ -2728,7 +2799,18 @@ class ServeEngine:
         """Advance the pool ``block_steps`` tokens; returns the emitted
         (K, max_batch) token matrix. Fused mode: ONE program call + ONE
         fetch. Stepwise mode: the same schedule paid per token (K dispatches
-        + K fetches) — the measurement baseline and exactness oracle."""
+        + K fetches) — the measurement baseline and exactness oracle. Sim
+        mode (inference/simlm.py): the stub's deterministic token function,
+        pure numpy, accounted like one fused dispatch + fetch."""
+        if self._sim:
+            rids = [(-1 if r is None else r.request_id) for r in self.slots]
+            toks = self._dispatch("decode", lambda: self.lm.sim_decode_block(
+                self.block_steps, self._tok, self._active, self._done,
+                self._gen_counts, rids))
+            self.session.lengths = self.session.lengths + self.block_steps
+            self.stats["program_calls"] += 1
+            self.stats["host_fetches"] += 1
+            return self._fetch(toks)
         if self.fused:
             fused = self.lm.compile_session_decode_fused(
                 self.block_steps, self.slot_sampler, self.pad_token_id)
@@ -2877,6 +2959,10 @@ class ServeEngine:
             free_slots=free,
             est_ttft_blocks=int(est),
             pool_retry_after_blocks=int(retry),
+            inflight_tokens=int(sum(
+                r.max_new_tokens - len(self._out.get(r.request_id, ()))
+                for r in self.slots if r is not None)),
+            queued_tokens=int(self.queue.tokens()),
             pages_in_use=pages_in_use,
             pages_free=pages_free,
             tier_pages=(pkv.tier_pages()
@@ -2912,8 +2998,7 @@ class ServeEngine:
             "role": self.role,
             "blocks": int(self.blocks),
             "queue_depth": load.queue_depth,
-            "arrived_depth": sum(1 for r in self.queue
-                                 if r.arrival_block <= self.blocks),
+            "arrived_depth": self.queue.arrived_count(self.blocks),
             "prefilling": load.prefilling,
             "replay_pending": load.replays,
             "slots": slots,
@@ -3228,7 +3313,18 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     :meth:`ServeEngine.request_timeline` read — so this entrypoint turns
     tracing on when the engine was built without it. Callers measuring the
     untraced fast path (the tracing-overhead bench) drive ``engine.run()``
-    directly."""
+    directly.
+
+    STREAMING MODE (``ServeEngine(keep_completions=False)``): the trace
+    may be a raw generator — requests submit only when the virtual clock
+    reaches their arrival, completions fold into counters and the engine's
+    log-bucket latency histograms as they finish, and the report is built
+    entirely from those aggregates (percentiles are histogram upper
+    edges; no per-request lists, no tracer requirement) — the memory-
+    bounded path million-request soaks run (ROADMAP #18)."""
+    if not getattr(engine, "keep_completions", True):
+        return _run_trace_streaming(engine, trace, max_blocks=max_blocks,
+                                    snapshot_path=snapshot_path)
     if not isinstance(trace, (list, tuple)):
         # single-engine runs materialize a streamed trace (the streamed
         # submit-at-arrival path lives in run_router_trace)
@@ -3462,3 +3558,98 @@ def run_trace(engine: ServeEngine, trace: List[dict],
                     if pkv._restore_ms else None),
             })
     return report
+
+
+def _submit_item(submit, item) -> None:
+    """Submit one synthetic-trace dict through ``submit`` (the engine's or
+    the router's) — the one place the trace-item schema is interpreted."""
+    submit(item["prompt"], item["max_new_tokens"],
+           eos_token_id=item.get("eos_token_id"),
+           arrival_block=item.get("arrival_block", 0),
+           ttft_deadline_ms=item.get("ttft_deadline_ms"),
+           deadline_ms=item.get("deadline_ms"),
+           tenant=item.get("tenant", "default"),
+           adapter=item.get("adapter"),
+           grammar=item.get("grammar"))
+
+
+def _run_trace_streaming(engine: ServeEngine, trace,
+                         max_blocks: Optional[int] = None,
+                         snapshot_path: Optional[str] = None) -> dict:
+    """Memory-bounded run_trace (``keep_completions=False``): submit at
+    arrival off a raw iterator, report entirely from the stats counters
+    and log-bucket histograms — O(in-flight) host memory regardless of
+    trace length, zero tracer requirement (ROADMAP #18)."""
+    if snapshot_path is not None:
+        raise ValueError("streaming runs do not snapshot (keep_completions"
+                         "=False drops the per-request record the snapshot"
+                         " would serialize)")
+    it = iter(trace)
+    nxt = next(it, None)
+    submitted = 0
+    has_deadlines = False
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        while (nxt is not None
+               and int(nxt.get("arrival_block", 0)) <= engine.blocks):
+            _submit_item(engine.submit, nxt)
+            submitted += 1
+            has_deadlines = has_deadlines or bool(
+                nxt.get("deadline_ms") or nxt.get("ttft_deadline_ms"))
+            nxt = next(it, None)
+        more = engine.step_block()
+        n += 1
+        if max_blocks is not None and n >= max_blocks:
+            break
+        if not more and nxt is None:
+            break
+    engine._sync_compile_metrics()
+    wall_s = time.perf_counter() - t0
+    st = engine.stats
+    completed = int(st["completed"])
+    total_tokens = int(st["generated_tokens"])
+    decode_blocks = max(int(st["decode_blocks"]), 1)
+    itl = engine._m_itl
+    rejected = int(st["rejected"])
+    missed = int(st["deadline_misses"])
+    return {
+        "streaming": True,
+        "percentile_basis": "log-bucket histogram upper edges",
+        "requests_submitted": submitted,
+        "requests_completed": completed,
+        "total_generated_tokens": total_tokens,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_sec": (round(total_tokens / wall_s, 1)
+                           if wall_s > 0 else None),
+        "goodput_tokens_per_sec": (
+            round(int(st["ontime_tokens"]) / wall_s, 1)
+            if wall_s > 0 else None),
+        "sched_overhead_us_per_request": (
+            round(wall_s * 1e6 / completed, 2) if completed else None),
+        "blocks": int(st["blocks"]),
+        "decode_blocks": int(st["decode_blocks"]),
+        "block_steps": engine.block_steps,
+        "fused": engine.fused,
+        "inserts": int(st["inserts"]),
+        "inserted_requests": int(st["inserted_requests"]),
+        "host_ops_per_block": round(
+            (int(st["program_calls"]) + int(st["host_fetches"]))
+            / decode_blocks, 2),
+        "queue_blocks_mean": (round(int(st["queue_blocks_sum"])
+                                    / completed, 2) if completed else None),
+        "ttft_blocks_mean": (round(int(st["ttft_blocks_sum"])
+                                   / completed, 2) if completed else None),
+        "itl_p50_ms": (round(itl.percentile(50), 3)
+                       if itl.count else None),
+        "itl_p99_ms": (round(itl.percentile(99), 3)
+                       if itl.count else None),
+        "rejected": rejected,
+        "expired": int(st["expired"]),
+        "shed_evictions": int(st["shed_evictions"]),
+        "deadline_miss_rate": (
+            round((rejected + missed) / submitted, 4)
+            if has_deadlines and submitted else None),
+        "deferred_admissions": int(st["deferred_admissions"]),
+        "dispatch_retries": int(st["dispatch_retries"]),
+    }

@@ -53,6 +53,7 @@ therefore immutable until its refcount drains to zero.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import time
 import zlib
 from collections import deque
@@ -178,6 +179,12 @@ class PageAllocator:
         self.reserved = int(reserved)
         self._free = deque(range(reserved, num_pages))
         self.refcount = np.zeros((num_pages,), np.int32)
+        # monotone mutation stamp: bumped on every refcount/free-list
+        # change so the prefix index can MEMOIZE its evictable/spillable
+        # counts (ROADMAP #18 — those counts are the scheduler's per-
+        # admission pool-feasibility probe; recomputing the trie walk per
+        # probe was an O(cached pages) scan on the placement hot path)
+        self.version = 0
         # fault-injection seam (inference/faults.py): when set, an alloc
         # that WOULD succeed may be forced down the exhausted path —
         # deterministic PagePoolExhausted storms for the chaos tests
@@ -198,6 +205,7 @@ class PageAllocator:
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
             self.refcount[p] = 1
+        self.version += 1
         return pages
 
     def retain(self, pages: Sequence[int]) -> None:
@@ -205,6 +213,8 @@ class PageAllocator:
             if self.refcount[p] <= 0:
                 raise ValueError(f"retain of free page {p}")
             self.refcount[p] += 1
+        if pages:
+            self.version += 1
 
     def release(self, pages: Sequence[int]) -> List[int]:
         """Drop one hold per page; returns the pages that hit refcount 0 and
@@ -217,6 +227,8 @@ class PageAllocator:
             if self.refcount[p] == 0:
                 self._free.append(p)
                 freed.append(p)
+        if pages:
+            self.version += 1
         return freed
 
 
@@ -245,7 +257,8 @@ class _Node:
     be BOTH device-resident and tiered (inclusive tier: a restored page
     keeps its host copy as a corruption-repair source)."""
 
-    __slots__ = ("children", "page", "parent", "key", "last_used", "tier_id")
+    __slots__ = ("children", "page", "parent", "key", "last_used", "tier_id",
+                 "dead")
 
     def __init__(self, key, page, parent):
         self.children: Dict[tuple, _Node] = {}
@@ -254,6 +267,7 @@ class _Node:
         self.parent = parent
         self.last_used = 0
         self.tier_id: Optional[int] = None
+        self.dead = False
 
 
 class RadixPrefixIndex:
@@ -272,10 +286,80 @@ class RadixPrefixIndex:
         self.tier: Optional[HostPageTier] = None
         self._read_page = None      # device page -> {leaf path: np bytes}
         self._tier_nodes: Dict[int, _Node] = {}
+        # ROADMAP #18 ordered structures: physical page -> trie node map
+        # (corruption repair used to walk the whole trie per probe), a
+        # lazy-deleted min-heap over (last_used, seq) for LRU victim
+        # selection in spill/evict (was a full-trie scan PER VICTIM), and
+        # a version-stamped memo for the evictable/spillable counts the
+        # scheduler probes per admission/placement decision
+        self._page_node: Dict[int, _Node] = {}
+        self._lru: List[Tuple[int, int, _Node]] = []
+        self._lru_seq = 0
+        self._mut = 0                       # structural mutation stamp
+        self._memo_key: Tuple[int, int] = (-1, -1)
+        self._memo: Tuple[int, int] = (0, 0)
 
     def attach_tier(self, tier: HostPageTier, read_page) -> None:
         self.tier = tier
         self._read_page = read_page
+        self._mut += 1
+
+    # --- ordered-structure maintenance -----------------------------------
+
+    def _touch(self, node: _Node) -> None:
+        """Stamp the node with the current clock and (re)enter it in the
+        LRU heap. Path nodes are touched root-first within one walk, and
+        the heap tie-breaks equal stamps by push order, so victim
+        selection among same-walk nodes keeps the old shallowest-first
+        iteration order."""
+        node.last_used = self._clock
+        self._lru_seq += 1
+        heapq.heappush(self._lru, (node.last_used, self._lru_seq, node))
+        if len(self._lru) > 64 + 4 * max(self.cached_pages, 1):
+            self._compact_lru()
+
+    def _compact_lru(self) -> None:
+        seen = set()
+        keep = []
+        for stamp, seq, node in sorted(self._lru):
+            if node.dead or node.last_used != stamp or id(node) in seen:
+                continue
+            seen.add(id(node))
+            keep.append((stamp, seq, node))
+        self._lru = keep
+        heapq.heapify(self._lru)
+
+    def _set_page(self, node: _Node, page: int) -> None:
+        """Single point of truth for a node's device residency: keeps the
+        page->node map in sync (the O(1) ``node_for_page``)."""
+        if node.page >= 0 and self._page_node.get(node.page) is node:
+            del self._page_node[node.page]
+        node.page = int(page)
+        if page >= 0:
+            self._page_node[int(page)] = node
+        self._mut += 1
+
+    def _pop_lru_victim(self, candidate) -> Optional[_Node]:
+        """Least-recently-used live node satisfying ``candidate`` via the
+        lazy heap: dead/stale entries are discarded permanently, valid
+        non-candidates (shared pages, already-tiered nodes) are kept
+        aside and restored — the pop cost is bounded by the trie size
+        (the pool), amortized far below the old full scan per victim."""
+        side = []
+        found = None
+        while self._lru:
+            item = heapq.heappop(self._lru)
+            stamp, _seq, node = item
+            if node.dead or node.last_used != stamp:
+                continue
+            if candidate(node):
+                found = node
+                side.append(item)
+                break
+            side.append(item)
+        for item in side:
+            heapq.heappush(self._lru, item)
+        return found
 
     def lookup(self, tokens: Sequence[int]) -> List[int]:
         """Physical page ids of the longest DEVICE-RESIDENT cached
@@ -301,7 +385,7 @@ class RadixPrefixIndex:
             child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
             if child is None:
                 break
-            child.last_used = self._clock
+            self._touch(child)
             out.append(child)
             node = child
         return out
@@ -325,13 +409,16 @@ class RadixPrefixIndex:
             node = child
         return pages
 
-    def evictable_pages(self) -> int:
-        """DEVICE pages LRU eviction could return to the free list right
-        now: cache-only (refcount 1) nodes whose whole subtree is also
-        evictable (eviction frees leaves first, so a cache-only node above a
-        slot-held page stays pinned). Tiered entries hold no device page —
-        they count 0 and are transparent (they never pin an ancestor). The
-        scheduler's pool-feasibility probe."""
+    def _counts(self) -> Tuple[int, int]:
+        """(evictable, spillable) with a version-stamped memo: the counts
+        only change when the allocator's refcounts/free list or the trie
+        structure do, so the scheduler's per-admission (and the router's
+        per-placement) feasibility probes between mutations are O(1)
+        instead of a full trie walk each (ROADMAP #18)."""
+        key = (self.allocator.version, self._mut)
+        if self._memo_key == key:
+            return self._memo
+
         def count(node) -> Tuple[int, bool]:
             total, all_ev = 0, True
             for c in node.children.values():
@@ -344,17 +431,32 @@ class RadixPrefixIndex:
                 return total + 1, True
             return total, False
 
-        return sum(count(c)[0] for c in self.root.children.values())
+        ev = sum(count(c)[0] for c in self.root.children.values())
+        sp = 0
+        if self.tier is not None:
+            sp = sum(1 for n in self._iter_nodes()
+                     if n.page >= 0 and self.allocator.refcount[n.page] == 1)
+        self._memo_key = key
+        self._memo = (ev, sp)
+        return self._memo
+
+    def evictable_pages(self) -> int:
+        """DEVICE pages LRU eviction could return to the free list right
+        now: cache-only (refcount 1) nodes whose whole subtree is also
+        evictable (eviction frees leaves first, so a cache-only node above a
+        slot-held page stays pinned). Tiered entries hold no device page —
+        they count 0 and are transparent (they never pin an ancestor). The
+        scheduler's pool-feasibility probe (memoized — see _counts)."""
+        return self._counts()[0]
 
     def spillable_pages(self) -> int:
         """DEVICE pages a spill could move to the host tier right now: ANY
         cache-only node, leaf or interior — spilling keeps the trie entry,
         so interior nodes are fair game (eviction can only drop leaves).
-        0 without a tier."""
+        0 without a tier. Memoized — see _counts."""
         if self.tier is None:
             return 0
-        return sum(1 for n in self._iter_nodes()
-                   if n.page >= 0 and self.allocator.refcount[n.page] == 1)
+        return self._counts()[1]
 
     def reclaimable_pages(self) -> int:
         """Device pages :meth:`reclaim` could free right now — the
@@ -373,12 +475,11 @@ class RadixPrefixIndex:
             return 0
         freed = 0
         while freed < n_pages:
-            victims = [n for n in self._iter_nodes()
-                       if n.page >= 0
-                       and self.allocator.refcount[n.page] == 1]
-            if not victims:
+            node = self._pop_lru_victim(
+                lambda n: n.page >= 0
+                and self.allocator.refcount[n.page] == 1)
+            if node is None:
                 return freed
-            node = min(victims, key=lambda n: n.last_used)
             if node.tier_id is None:
                 tid, dropped = self.tier.put(self._read_page(node.page))
                 node.tier_id = tid
@@ -386,8 +487,9 @@ class RadixPrefixIndex:
                 for d in dropped:
                     self._on_tier_drop(d)
             if node.page >= 0:
-                freed += len(self.allocator.release([node.page]))
-                node.page = -1
+                page = node.page
+                self._set_page(node, -1)
+                freed += len(self.allocator.release([page]))
             else:
                 # a tier-LRU cascade dropped an ancestor whose subtree
                 # included this node — its device page was freed there
@@ -401,17 +503,16 @@ class RadixPrefixIndex:
         if node is None:
             return
         node.tier_id = None
+        self._mut += 1
         if node.page < 0 and node.key in getattr(node.parent, "children", {}):
             self._drop_subtree(node)
             del node.parent.children[node.key]
 
     def node_for_page(self, page: int) -> Optional[_Node]:
         """The trie node currently holding device page ``page`` (None when
-        the page is request-private) — the corruption-repair probe."""
-        for n in self._iter_nodes():
-            if n.page == int(page):
-                return n
-        return None
+        the page is request-private) — the corruption-repair probe, O(1)
+        off the page->node map."""
+        return self._page_node.get(int(page))
 
     def register(self, tokens: Sequence[int], pages: Sequence[int]) -> None:
         """Record prompt pages AFTER their K/V were written. A page whose
@@ -430,14 +531,15 @@ class RadixPrefixIndex:
             key = tuple(tokens[i * ps:(i + 1) * ps])
             child = node.children.get(key)
             if child is None:
-                child = _Node(key, int(page), node)
+                child = _Node(key, -1, node)
                 node.children[key] = child
+                self._set_page(child, int(page))
                 self.allocator.retain([int(page)])
                 self.cached_pages += 1
             elif child.page < 0:
-                child.page = int(page)
+                self._set_page(child, int(page))
                 self.allocator.retain([int(page)])
-            child.last_used = self._clock
+            self._touch(child)
             node = child
 
     def evict(self, n_pages: int) -> int:
@@ -450,12 +552,11 @@ class RadixPrefixIndex:
         pages actually freed."""
         freed = 0
         while freed < n_pages:
-            leaves = [c for c in self._iter_nodes()
-                      if not c.children and c.page >= 0
-                      and self.allocator.refcount[c.page] == 1]
-            if not leaves:
+            victim = self._pop_lru_victim(
+                lambda c: not c.children and c.page >= 0
+                and self.allocator.refcount[c.page] == 1)
+            if victim is None:
                 return freed
-            victim = min(leaves, key=lambda c: c.last_used)
             del victim.parent.children[victim.key]
             freed += self._drop_subtree(victim)
         return freed
@@ -517,13 +618,17 @@ class RadixPrefixIndex:
         freed = 0
         self.cached_pages -= 1
         if node.page >= 0:
-            freed += len(self.allocator.release([node.page]))
+            page = node.page
+            self._set_page(node, -1)
+            freed += len(self.allocator.release([page]))
         if node.tier_id is not None:
             if self.tier is not None:
                 self.tier.drop(node.tier_id)
             self._tier_nodes.pop(node.tier_id, None)
         node.page = -1
         node.tier_id = None
+        node.dead = True
+        self._mut += 1
         for child in node.children.values():
             freed += self._drop_subtree(child)
         return freed
@@ -696,7 +801,7 @@ class PagedKVCache:
             self._note_exhausted(1)
             return None
         self._write_page(pages[0], data)
-        node.page = pages[0]
+        self.prefix._set_page(node, pages[0])
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._restore_ms.append(dt_ms)
         self.stats["tier_restored_pages"] += 1

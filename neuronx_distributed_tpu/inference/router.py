@@ -81,6 +81,7 @@ registries — per-replica counters must not sum silently.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -91,11 +92,13 @@ import numpy as np
 from neuronx_distributed_tpu.inference.engine import (
     Completion,
     Rejected,
+    ReplicaLoad,
     Request,
     ServeEngine,
     per_tenant_report,
 )
 from neuronx_distributed_tpu.inference.faults import FaultInjector, FaultPlan
+from neuronx_distributed_tpu.inference.schedq import PendingQueue
 from neuronx_distributed_tpu.observability import (
     FlightRecorder,
     MetricsRegistry,
@@ -176,6 +179,8 @@ class Router:
         replica_queue_depth: int = 0,
         snapshot_every_blocks: int = 0,
         record_streams: bool = True,
+        keep_completions: bool = True,
+        record_block_wall: bool = True,
         faults: Optional[Union[FaultPlan, FaultInjector]] = None,
         crash_at: Sequence[Tuple[int, int]] = (),
         autoscaler=None,
@@ -206,7 +211,17 @@ class Router:
         self.replica_queue_depth = int(replica_queue_depth)
         self.snapshot_every_blocks = int(snapshot_every_blocks)
         self.record_streams = bool(record_streams)
-        self.rng = rng if rng is not None else jax.random.key(0)
+        # ROADMAP #18 memory bounds: keep_completions=False folds finished
+        # streams into aggregate counters (the streaming report's source)
+        # instead of the completed/rejected lists; record_block_wall=False
+        # drops the per-replica per-block wall ledger (the disagg decode
+        # clock needs it; a 1M-block soak does not)
+        self.keep_completions = bool(keep_completions)
+        self.record_block_wall = bool(record_block_wall)
+        # sim fleets (inference/simlm.py) never sample: skip the jax key
+        # so a host-only soak performs zero XLA work
+        self.rng = (None if getattr(lm, "sim", False)
+                    else rng if rng is not None else jax.random.key(0))
         self.tracer = tracer if tracer is not None else Tracer(
             enabled=bool(trace))
         self.metrics = metrics if metrics is not None else MetricsRegistry()
@@ -249,7 +264,10 @@ class Router:
         self._vtime = 0.0
         self._tenants: Dict[str, _Tenant] = {}
         self._tenant_weights = dict(tenant_weights or {})
-        self.pending: deque[_Entry] = deque()
+        # heap-backed placement backlog (inference/schedq.py): WFQ order,
+        # arrival/backoff gates, per-(role, tenant) cost sums and shed
+        # victims in O(log n) instead of per-block sorts/scans
+        self.pending: PendingQueue = PendingQueue()
         self.completed: List[Completion] = []
         self.rejected: List[Rejected] = []
         self._records: Dict[int, _Record] = {}
@@ -277,6 +295,43 @@ class Router:
         self._adapter_registry: Dict[str, Tuple] = {}
         self._grammar_registry: Dict[str, dict] = {}
         self.last_spawn: Dict[str, object] = {}
+        # incrementally-maintained placement state (ROADMAP #18): one
+        # ReplicaLoad per replica refreshed ONCE per block (after its
+        # engine steps) and mirrored through every router-side mutation
+        # (placements, resumes, extracts), so _can_take/_load_score stop
+        # recomputing per request x per replica; running fleet sums back
+        # the O(1) _free_capacity/_retry_after; the affinity index maps a
+        # prompt's first-page key to the replicas that MAY hold it hot
+        # (placement-recorded, peek-confirmed — false positives decay on
+        # probe, false negatives cannot occur because every prefix enters
+        # a replica's radix through a router-recorded placement)
+        self._rload: List[ReplicaLoad] = []
+        self._contrib: List[bool] = []
+        self._fleet_free_slots = 0
+        self._fleet_rate = 0
+        self._fleet_inflight_tokens = 0
+        self._open: set = set()
+        # least-loaded fast path (ROADMAP #18): a lazy heap over the open
+        # set ordered by the REQUEST-INDEPENDENT score prefix; valid (and
+        # exact) whenever the top replica passes the request's pool check
+        # — placement is then O(log fleet) instead of a full score scan.
+        # Subclasses that filter viability by role (DisaggRouter) fall
+        # back to the scan automatically.
+        self._open_heap: List[Tuple] = []
+        self._uniform_viability = (
+            type(self)._viable_replicas is Router._viable_replicas)
+        self._affinity: Dict[Tuple, set] = {}
+        pkv0 = getattr(self.engines[0].session, "paged", None)
+        self._aff_ps = pkv0.page_size if pkv0 is not None else 0
+        # streaming-report aggregates (filled by _harvest regardless of
+        # keep_completions — cheap, and the two report paths then agree)
+        self._agg = {"completed": 0, "tokens": 0, "ontime_tokens": 0,
+                     "expired": 0, "missed": 0, "cancelled": 0,
+                     "ttft_blocks_sum": 0, "queue_blocks_sum": 0}
+        for i, eng in enumerate(self.engines):
+            self._rload.append(eng.load_summary())
+            self._contrib.append(False)
+            self._contrib_on(i)
         self.stats = {
             "placements": 0, "affinity_placements": 0, "requeues": 0,
             "rejected": 0, "shed_evictions": 0, "crashes": 0,
@@ -379,6 +434,8 @@ class Router:
         self._hb[i] = self.blocks
         self._hc[i] = 0
         self._hr[i] = 0
+        self._rload[i] = eng.load_summary()
+        self._contrib_on(i)
         return i
 
     def _spawn(self, role: str) -> int:
@@ -404,13 +461,89 @@ class Router:
         # provisioned for zero of the elapsed blocks
         self._eng_block_wall.append(
             [0.0] * len(self._eng_block_wall[0])
-            if self._eng_block_wall else [])
+            if self._eng_block_wall and self.record_block_wall else [])
+        self._rload.append(eng.load_summary())
+        self._contrib.append(False)
+        self._contrib_on(i)
         self._note_new_replica(i, role)
         return i
 
     def _note_new_replica(self, i: int, role: str) -> None:
         """Post-append hook — :class:`DisaggRouter` extends its role
         table here."""
+
+    # --- per-block load cache (ROADMAP #18) -------------------------------
+
+    def _contrib_on(self, i: int) -> None:
+        if self._contrib[i]:
+            return
+        rl = self._rload[i]
+        self._fleet_free_slots += rl.free_slots
+        self._fleet_inflight_tokens += rl.inflight_tokens + rl.queued_tokens
+        eng = self.engines[i]
+        self._fleet_rate += eng.lm.max_batch * eng.block_steps
+        self._contrib[i] = True
+
+    def _contrib_off(self, i: int) -> None:
+        if not self._contrib[i]:
+            return
+        rl = self._rload[i]
+        self._fleet_free_slots -= rl.free_slots
+        self._fleet_inflight_tokens -= rl.inflight_tokens + rl.queued_tokens
+        eng = self.engines[i]
+        self._fleet_rate -= eng.lm.max_batch * eng.block_steps
+        self._contrib[i] = False
+
+    def _refresh_load(self, i: int) -> None:
+        """Re-read replica ``i``'s typed load summary (once per block,
+        after its engine stepped — plus after router-driven mutations like
+        drain extraction), keeping the live-fleet running sums exact."""
+        fresh = self.engines[i].load_summary()
+        if self._contrib[i]:
+            old = self._rload[i]
+            self._fleet_free_slots += fresh.free_slots - old.free_slots
+            self._fleet_inflight_tokens += (
+                (fresh.inflight_tokens + fresh.queued_tokens)
+                - (old.inflight_tokens + old.queued_tokens))
+        self._rload[i] = fresh
+
+    def _mirror_place(self, i: int, e: "_Entry") -> None:
+        """Apply one placement's effect to the cached summary — exactly
+        what a fresh load_summary() would report (placement only ever
+        queues work; slots/pages change when the engine steps)."""
+        rl = self._rload[i]
+        rl.backlog += 1
+        if e.replay:
+            rl.replays += 1
+        else:
+            rl.queue_depth += 1
+            rl.queued_tokens += int(e.req.max_new_tokens)
+            if self._contrib[i]:
+                self._fleet_inflight_tokens += int(e.req.max_new_tokens)
+        if not (rl.free_slots > rl.queue_depth
+                or rl.queue_depth < self.replica_queue_depth):
+            self._open.discard(i)
+
+    def _note_affinity(self, req: Request, i: int) -> None:
+        """Record that replica ``i`` is about to hold ``req``'s prompt
+        prefix (its admission registers the pages) — the affinity probe
+        set for future placements of the same first page."""
+        ps = self._aff_ps
+        if (not ps or req.prompt.size <= ps
+                or self.placement != "affinity"):
+            # only the affinity policy reads the index; recording under
+            # least_loaded/round_robin would grow one key per distinct
+            # first-page prefix for nothing (the soak's leak budget)
+            return
+        key = (req.adapter, req.prompt[:ps].tobytes())
+        self._affinity.setdefault(key, set()).add(i)
+
+    def _affinity_candidates(self, req: Request) -> set:
+        ps = self._aff_ps
+        if not ps or req.prompt.size <= ps:
+            return set()
+        return self._affinity.get((req.adapter, req.prompt[:ps].tobytes()),
+                                  set())
 
     # --- tenants / fairness ----------------------------------------------
 
@@ -440,15 +573,6 @@ class Router:
     def _arrived(self, e: _Entry) -> bool:
         return (e.req.arrival_block <= self.blocks
                 and e.not_before <= self.blocks)
-
-    def _placement_order(self) -> List[_Entry]:
-        """Arrived backlog in placement order: failover replays first (the
-        client is mid-stream — they outrank any fresh admission), then WFQ
-        virtual finish tags, ids as the deterministic tiebreak."""
-        arrived = [e for e in self.pending if self._arrived(e)]
-        arrived.sort(key=lambda e: (not e.replay, e.finish_tag,
-                                    e.req.request_id))
-        return arrived
 
     # --- submission -------------------------------------------------------
 
@@ -514,7 +638,12 @@ class Router:
         t.finish = start + self._cost(req) / t.weight
         entry = _Entry(req=req, v_start=start, finish_tag=t.finish,
                        not_before=int(arrival_block))
-        self._tenant_of[rid] = req.tenant
+        if self.keep_completions:
+            # the per-rid tenant map only feeds the retained-report's
+            # rejected-tenant table; in streaming mode it would be the one
+            # O(trace)-growth dict left (the RSS leak detector's job is to
+            # prove there are none)
+            self._tenant_of[rid] = req.tenant
         self.metrics.counter("router_tenant_requests_total",
                              help="requests submitted per tenant",
                              tenant=req.tenant).inc()
@@ -527,64 +656,60 @@ class Router:
                       "finish_tag": round(t.finish, 3)})
         if (self.max_pending is not None
                 and req.arrival_block <= self.blocks):
-            arrived = [e for e in self.pending if self._arrived(e)]
-            if len(arrived) >= self.max_pending + self._free_capacity():
-                verdict = self._shed_tenant(entry, arrived)
+            arrived_n = self.pending.ready_count(self.blocks)
+            if arrived_n >= self.max_pending + self._free_capacity():
+                verdict = self._shed_tenant(entry, arrived_n)
                 if verdict is not None:
                     return verdict
         self.pending.append(entry)
         self._records[rid] = _Record(req=req, tenant=req.tenant,
                                      finish_tag=entry.finish_tag,
                                      v_start=entry.v_start)
-        self._m_pending.set(sum(1 for e in self.pending if self._arrived(e)))
+        self._m_pending.set(self.pending.ready_count(self.blocks))
         return rid
 
     def _free_capacity(self) -> int:
-        return sum(len(self.engines[i]._free_slots())
-                   for i in self._live_replicas())
+        # running sum over the live fleet's cached load summaries — O(1)
+        # per submit instead of an every-replica slot scan
+        return self._fleet_free_slots
 
     def _retry_after(self) -> int:
         """Fleet-wide backlog-drain estimate in blocks (the shed verdict's
         resubmission hint): undelivered token budget over the live
-        replicas' aggregate K*slots service rate."""
-        pend = sum(e.req.max_new_tokens - len(e.generated)
-                   for e in self.pending)
-        inflight = 0
-        rate = 0
-        for i in self._live_replicas():
-            eng = self.engines[i]
-            inflight += sum(
-                r.max_new_tokens - len(eng._out.get(r.request_id, []))
-                for r in eng.slots if r is not None)
-            inflight += sum(r.max_new_tokens for r in eng.queue)
-            rate += eng.lm.max_batch * eng.block_steps
-        return max(1, -(-(pend + inflight) // max(rate, 1)))
+        replicas' aggregate K*slots service rate — all running sums."""
+        pend = self.pending.pending_tokens()
+        return max(1, -(-(pend + self._fleet_inflight_tokens)
+                        // max(self._fleet_rate, 1)))
 
     def _shed_tenant(self, newcomer: _Entry,
-                     arrived: List[_Entry]) -> Optional[Rejected]:
+                     arrived_n: int) -> Optional[Rejected]:
         """Tenant-aware overflow: the victim tenant is the one FURTHEST
-        over its weighted share of the arrived backlog (cost/weight), and
-        within it the newest entry sheds first — a burst eats its own tail.
-        Returns the newcomer's verdict, or None when a queued entry shed
-        instead (the newcomer is admitted in its place)."""
-        usage: Dict[str, float] = {}
-        for e in arrived + [newcomer]:
-            t = self._tenant(e.req.tenant)
-            usage[e.req.tenant] = (usage.get(e.req.tenant, 0.0)
-                                   + self._cost(e.req) / t.weight)
+        over its weighted share of the arrived backlog (integer token cost
+        over weight, read off the pending queue's incremental sums), and
+        within it the newest entry sheds first — a burst eats its own
+        tail. Returns the newcomer's verdict, or None when a queued entry
+        shed instead (the newcomer is admitted in its place)."""
+        usage: Dict[str, float] = {
+            t: c / self._tenant(t).weight
+            for t, c in self.pending.role_tenant_cost(None).items()}
+        tn = self._tenant(newcomer.req.tenant)
+        usage[newcomer.req.tenant] = (usage.get(newcomer.req.tenant, 0.0)
+                                      + self._cost(newcomer.req) / tn.weight)
         victim_tenant = max(sorted(usage), key=lambda k: usage[k])
-        candidates = [e for e in arrived + [newcomer]
-                      if e.req.tenant == victim_tenant and not e.replay]
-        if not candidates:
-            candidates = [newcomer]
-        victim = max(candidates, key=lambda e: e.req.request_id)
+        if victim_tenant == newcomer.req.tenant:
+            # the newcomer is always the newest entry of its own tenant
+            victim = newcomer
+        else:
+            victim = (self.pending.newest_victim(victim_tenant)
+                      or newcomer)
         rej = Rejected(
             request_id=victim.req.request_id,
             retry_after_blocks=min(self._retry_after(),
                                    self.retry_after_cap_blocks),
-            queue_depth=len(arrived),
+            queue_depth=arrived_n,
             reason="tenant_over_budget")
-        self.rejected.append(rej)
+        if self.keep_completions:
+            self.rejected.append(rej)
         self.stats["rejected"] += 1
         self.metrics.counter("router_tenant_shed_total",
                              help="requests shed per tenant",
@@ -619,12 +744,12 @@ class Router:
         re-routed to a hotter prefix: replica-side queueing front-runs WFQ,
         so it is off by default (``replica_queue_depth=0``); raising the
         knob trades fairness granularity for placement latency."""
-        eng = self.engines[i]
-        if (len(eng._free_slots()) > len(eng.queue)
-                and eng._pool_can_admit(req.prompt.size,
-                                        req.max_new_tokens)):
+        rl = self._rload[i]
+        if (rl.free_slots > rl.queue_depth
+                and self.engines[i]._pool_can_admit(req.prompt.size,
+                                                    req.max_new_tokens)):
             return True
-        return len(eng.queue) < self.replica_queue_depth
+        return rl.queue_depth < self.replica_queue_depth
 
     def _load_score(self, i: int, req: Request) -> Tuple:
         """Least-loaded / deadline-aware ordering key (smaller is better):
@@ -635,54 +760,138 @@ class Router:
         adapter) — then estimated TTFT in blocks (0 with a free slot +
         pool room, else the soonest retirement estimate plus the queued
         backlog), then backlog depth, then fewest pages in use."""
-        eng = self.engines[i]
-        load = eng.load_summary()
+        load = self._rload[i]
         adapter_miss = 0
         if req.adapter is not None and load.adapters_resident is not None:
             adapter_miss = 0 if req.adapter in load.adapters_resident else 1
-        if load.free_slots and load.backlog == 0 and eng._pool_can_admit(
-                req.prompt.size, req.max_new_tokens):
+        if load.free_slots and load.backlog == 0 \
+                and self.engines[i]._pool_can_admit(
+                    req.prompt.size, req.max_new_tokens):
             est_ttft = 0
         else:
             est_ttft = load.pool_retry_after_blocks + load.backlog
         return (adapter_miss, est_ttft, load.backlog, -load.free_slots,
                 load.pages_in_use or 0, i)
 
+    def _score0(self, i: int) -> Tuple:
+        """Request-independent placement score (the full ``_load_score``
+        with ``adapter_miss=0`` and the pool check assumed to pass): a
+        LOWER BOUND on any request's actual score for this replica, which
+        is what makes the heap fast path exact — see ``_fast_pick``."""
+        rl = self._rload[i]
+        est0 = (0 if rl.free_slots and rl.backlog == 0
+                else rl.pool_retry_after_blocks + rl.backlog)
+        return (0, est0, rl.backlog, -rl.free_slots,
+                rl.pages_in_use or 0, i)
+
+    def _fast_pick(self, e: _Entry) -> Optional[int]:
+        """O(log fleet) least-loaded pick off the open heap. Returns the
+        EXACT argmin of ``_load_score`` over the viable set, or None to
+        fall back to the full scan — whenever the heap top fails the
+        request's pool-feasibility check (its actual score then exceeds
+        its optimistic key, so some other replica might win) or the
+        request carries an adapter (the affinity term re-orders)."""
+        if e.req.adapter is not None or not self._uniform_viability:
+            return None
+        h = self._open_heap
+        while h:
+            key, i = h[0]
+            if i not in self._open:
+                heapq.heappop(h)
+                continue
+            cur = self._score0(i)
+            if cur != key:
+                heapq.heapreplace(h, (cur, i))
+                continue
+            rl = self._rload[i]
+            if not self.engines[i]._pool_can_admit(
+                    e.req.prompt.size, e.req.max_new_tokens):
+                # pool-blocked top: its true score is larger than the key
+                # and _can_take may reject it — only the full scan is
+                # exact now (rare: the fleet is page-bound)
+                return None
+            if (rl.free_slots > rl.queue_depth
+                    or rl.queue_depth < self.replica_queue_depth):
+                return i
+            heapq.heappop(h)   # stale open membership
+        return None
+
     def _viable_replicas(self, e: _Entry) -> List[int]:
-        """Live replicas that can take this entry right now — the seam
-        :class:`DisaggRouter` overrides with role filtering (fresh work →
-        prefill workers, mid-stream replays → decode workers)."""
-        return [i for i in self._live_replicas()
+        """Replicas from the open set (live, with an unclaimed slot or
+        queue room — maintained per block + per placement) that can take
+        this entry right now — the seam :class:`DisaggRouter` overrides
+        with role filtering (fresh work → prefill workers, mid-stream
+        replays → decode workers)."""
+        return [i for i in sorted(self._open)
                 if self._can_take(i, e.req)]
 
     def _pick_replica(self, e: _Entry) -> Tuple[Optional[int], int]:
         """Choose a replica for one entry; returns (replica, prefix_hit
-        tokens) — (None, 0) when nobody can take it this block."""
-        viable = self._viable_replicas(e)
-        if not viable:
-            return None, 0
+        tokens) — (None, 0) when nobody can take it this block. The
+        least-loaded decision goes through the O(log fleet) heap fast
+        path when it is provably exact (``_fast_pick``); otherwise the
+        full viable-set scan runs — identical ordering either way."""
         if self.placement == "round_robin":
-            order = sorted(viable)
-            pick = order[self._rr_next % len(order)]
+            viable = self._viable_replicas(e)
+            if not viable:
+                return None, 0
+            pick = viable[self._rr_next % len(viable)]
             self._rr_next += 1
             return pick, 0
         if self.placement == "affinity":
             hits = {}
-            for i in viable:
-                pkv = self.engines[i].session.paged
-                if pkv is not None:
-                    # affinity probes under the request's adapter namespace:
-                    # only a SAME-adapter prefix is a real hit
-                    hits[i] = pkv.prefix_peek(e.req.prompt.tolist(),
-                                              ns=e.req.adapter)
+            cands = self._affinity_candidates(e.req)
+            if cands:
+                toks = e.req.prompt.tolist()
+                key = (e.req.adapter, e.req.prompt[:self._aff_ps].tobytes())
+                # probe only index candidates, but through the VIABLE set
+                # (role filtering lives in the subclass override — a
+                # decode replay must never probe its old prefill worker)
+                for i in self._viable_replicas(e):
+                    if i not in cands:
+                        continue
+                    pkv = self.engines[i].session.paged
+                    if pkv is None:
+                        continue
+                    # affinity probes under the request's adapter
+                    # namespace: only a SAME-adapter prefix is a real hit
+                    h = pkv.prefix_peek(toks, ns=e.req.adapter)
+                    if h > 0:
+                        hits[i] = h
+                    else:
+                        # the prefix went cold there (evicted): decay the
+                        # index entry — it re-arms on the next placement
+                        cands.discard(i)
+                if not cands:
+                    self._affinity.pop(key, None)
             best = max(hits.values()) if hits else 0
             if best > 0:
                 hot = [i for i, h in hits.items() if h == best]
                 return min(hot, key=lambda i: self._load_score(i, e.req)), best
+        pick = self._fast_pick(e)
+        if pick is not None:
+            return pick, 0
+        viable = self._viable_replicas(e)
+        if not viable:
+            return None, 0
         return min(viable, key=lambda i: self._load_score(i, e.req)), 0
 
     def _place(self) -> None:
-        for e in self._placement_order():
+        # open set: live replicas with an unclaimed free slot or queue
+        # room — the request-independent half of _can_take, refreshed per
+        # block and shrunk as placements claim capacity, so a saturated
+        # fleet skips the backlog scan entirely
+        self._open = {
+            i for i in self._live_replicas()
+            if (self._rload[i].free_slots > self._rload[i].queue_depth
+                or self._rload[i].queue_depth < self.replica_queue_depth)}
+        if not self._open:
+            return
+        self._open_heap = [(self._score0(i), i) for i in self._open]
+        heapq.heapify(self._open_heap)
+        for e in self.pending.iter_ready(self.blocks):
+            if not self._open:
+                break
             i, hit = self._pick_replica(e)
             if i is None:
                 continue
@@ -704,6 +913,8 @@ class Router:
                 self.pending.remove(e)
                 continue
             self.pending.remove(e)
+            self._mirror_place(i, e)
+            self._note_affinity(e.req, i)
             self._vtime = max(self._vtime, e.v_start)
             if rec is not None:
                 rec.replica = i
@@ -733,7 +944,8 @@ class Router:
         else:
             requeues = self.max_requeues + 1   # no record left: surface it
         if requeues > self.max_requeues:
-            self.rejected.append(rej)
+            if self.keep_completions:
+                self.rejected.append(rej)
             self.stats["rejected"] += 1
             self._records.pop(e.req.request_id, None)
             if self.tracer.enabled:
@@ -770,6 +982,8 @@ class Router:
     def _go_dark(self, i: int, why: str) -> None:
         self._dark.add(i)
         self._draining.discard(i)
+        self._contrib_off(i)
+        self._open.discard(i)
         self.stats["crashes"] += 1
         if self.tracer.enabled:
             self.tracer.instant(
@@ -836,9 +1050,7 @@ class Router:
                    else snap_gen.get(rid, []))
             rec.replica = None
             rec.delivered = list(gen)
-            self.pending.appendleft(_Entry(
-                req=rec.req, v_start=rec.v_start, finish_tag=rec.finish_tag,
-                replay=True, generated=gen))
+            self.pending.appendleft(self._make_replay_entry(rec, gen))
             moved += 1
         self.stats["failovers"] += 1
         self.stats["failed_over_requests"] += moved
@@ -850,6 +1062,13 @@ class Router:
                 args={"replica": i, "requests": moved,
                       "from_snapshot": not self.record_streams
                       and snap is not None})
+
+    def _make_replay_entry(self, rec: _Record, gen: List[int]) -> _Entry:
+        """Failover re-entry for one request (original fairness tags —
+        a crash must not re-charge the tenant). The DisaggRouter override
+        flips zero-token replays back to fresh prefill work."""
+        return _Entry(req=rec.req, v_start=rec.v_start,
+                      finish_tag=rec.finish_tag, replay=True, generated=gen)
 
     # --- graceful drain ---------------------------------------------------
 
@@ -867,6 +1086,8 @@ class Router:
         if i in self._draining:
             return
         self._draining.add(i)
+        self._contrib_off(i)
+        self._open.discard(i)
         self._drain_t0[i] = time.perf_counter()
         self._migrate_placeable(i)
         if self.tracer.enabled:
@@ -889,6 +1110,7 @@ class Router:
         for e in sorted(moved, key=lambda e: e.req.request_id, reverse=True):
             self.pending.appendleft(e)
         self.stats["drain_migrated_requests"] += len(moved)
+        self._refresh_load(i)
 
     def _reentry(self, req: Request, replay: bool,
                  generated: Optional[List[int]] = None) -> _Entry:
@@ -929,35 +1151,67 @@ class Router:
         """Pull replica ``i``'s freshly-finished completions/rejections and
         refresh the router's per-request delivery records — the records a
         failover replays from, updated every block so at most ONE block of
-        deliveries is ever re-sent."""
+        deliveries is ever re-sent. Delivery records refresh INCREMENTALLY:
+        only streams that emitted THIS block (``eng._emitted``), and only
+        the new token suffix — the old full rebuild walked every in-flight
+        stream's whole token list per block, an O(streams x tokens) cost
+        that scaled with fleet-wide in-flight count (ISSUE 14 satellite)."""
         eng = self.engines[i]
-        for c in eng.completed[self._hc[i]:]:
-            self.completed.append(c)
-            self._records.pop(c.request_id, None)
-            self.metrics.counter("router_tenant_tokens_total",
-                                 help="tokens delivered per tenant",
-                                 tenant=c.tenant).inc(len(c.tokens))
-        self._hc[i] = len(eng.completed)
-        for rej in eng.rejected[self._hr[i]:]:
-            rec = self._records.get(rej.request_id)
-            if rec is None:
-                continue
-            e = _Entry(req=rec.req, v_start=rec.v_start,
-                       finish_tag=rec.finish_tag)
-            self._requeue_or_reject(e, rej)
-        self._hr[i] = len(eng.rejected)
-        if self.record_streams:
-            for rid, toks in eng._out.items():
+        if len(eng.completed) > self._hc[i]:
+            for c in eng.completed[self._hc[i]:]:
+                self._records.pop(c.request_id, None)
+                self.metrics.counter("router_tenant_tokens_total",
+                                     help="tokens delivered per tenant",
+                                     tenant=c.tenant).inc(len(c.tokens))
+                self._agg["completed"] += 1
+                self._agg["tokens"] += len(c.tokens)
+                self._agg["ttft_blocks_sum"] += c.ttft_blocks
+                self._agg["queue_blocks_sum"] += c.queue_blocks
+                if c.expired:
+                    self._agg["expired"] += 1
+                if c.deadline_missed:
+                    self._agg["missed"] += 1
+                if c.cancelled:
+                    self._agg["cancelled"] += 1
+                if not (c.deadline_missed or c.expired or c.cancelled):
+                    self._agg["ontime_tokens"] += len(c.tokens)
+                if self.keep_completions:
+                    self.completed.append(c)
+            if self.keep_completions:
+                self._hc[i] = len(eng.completed)
+            else:
+                # streaming mode: the engine-side list is drained every
+                # block, so a 1M-request soak holds O(in-flight) memory
+                eng.completed.clear()
+                self._hc[i] = 0
+        if len(eng.rejected) > self._hr[i]:
+            for rej in eng.rejected[self._hr[i]:]:
+                rec = self._records.get(rej.request_id)
+                if rec is None:
+                    continue
+                e = _Entry(req=rec.req, v_start=rec.v_start,
+                           finish_tag=rec.finish_tag)
+                self._requeue_or_reject(e, rej)
+            if self.keep_completions:
+                self._hr[i] = len(eng.rejected)
+            else:
+                eng.rejected.clear()
+                self._hr[i] = 0
+        if self.record_streams and eng._emitted:
+            for rid in eng._emitted:
                 rec = self._records.get(rid)
-                if rec is not None:
-                    rec.delivered = list(toks)
+                if rec is None:
+                    continue
+                toks = eng._out.get(rid)
+                if toks is not None and len(toks) > len(rec.delivered):
+                    rec.delivered.extend(toks[len(rec.delivered):])
 
     def _pump_handoffs(self) -> None:
         """Prefill→decode handoff choreography — a no-op here; the
         :class:`DisaggRouter` (inference/disagg.py) overrides it."""
 
     def _observe_block(self) -> None:
-        depth = sum(1 for e in self.pending if self._arrived(e))
+        depth = self.pending.ready_count(self.blocks)
         self._m_pending.set(depth)
         live = len(self._live_replicas())
         self._m_replicas.set(live)
@@ -982,10 +1236,12 @@ class Router:
             self.autoscaler.observe_block(self)
         self._place()
         progressed = False
+        rec_wall = self.record_block_wall
         for i, eng in enumerate(self.engines):
             if (not self._alive[i] or i in self._dark
                     or i in self._drained):
-                self._eng_block_wall[i].append(0.0)
+                if rec_wall:
+                    self._eng_block_wall[i].append(0.0)
                 continue
             # provisioned-capacity ledger: every stepped replica (draining
             # ones included — they still hold hardware) is one replica-
@@ -995,9 +1251,13 @@ class Router:
             t0 = time.perf_counter()
             if eng.step_block():
                 progressed = True
-            self._eng_block_wall[i].append(time.perf_counter() - t0)
+            if rec_wall:
+                self._eng_block_wall[i].append(time.perf_counter() - t0)
             self._hb[i] = self.blocks
             self._harvest(i)
+            # the once-per-block load read every placement/autoscale/shed
+            # decision shares until the next step (ROADMAP #18)
+            self._refresh_load(i)
         self._pump_handoffs()
         if (self.snapshot_every_blocks
                 and (self.blocks + 1) % self.snapshot_every_blocks == 0):
@@ -1096,12 +1356,21 @@ def run_router_trace(router: Router, trace,
     alive through arrival gaps — the idle valleys autoscaling scales down
     into. Token streams are identical either way (the per-request rng
     contract); WFQ tags and wall accounting differ slightly in basis
-    (streamed submission happens inside the timed loop)."""
-    if not router.tracer.enabled:
+    (streamed submission happens inside the timed loop).
+
+    STREAMING REPORT (``Router(keep_completions=False)``): tracing is NOT
+    force-enabled, no per-request lists are materialized anywhere — the
+    report reads the harvest aggregates and the per-replica log-bucket
+    latency histograms merged explicitly (percentiles are bucket upper
+    edges). The memory-bounded mode the 1M-request soak runs
+    (``scripts/soak.py`` — ROADMAP #18)."""
+    streaming = not getattr(router, "keep_completions", True)
+    if not streaming and not router.tracer.enabled:
         router.tracer.enabled = True
     # O(1)-per-request bookkeeping (tenant label + deadline flag) — the
     # report's denominator; deliberately NOT the items themselves
     meta: List[Tuple[str, bool]] = []
+    counts = {"submitted": 0, "deadlines": False}
 
     def _submit(item):
         router.submit(item["prompt"], item["max_new_tokens"],
@@ -1112,9 +1381,13 @@ def run_router_trace(router: Router, trace,
                       tenant=item.get("tenant", "default"),
                       adapter=item.get("adapter"),
                       grammar=item.get("grammar"))
-        meta.append((item.get("tenant", "default"),
-                     bool(item.get("deadline_ms")
-                          or item.get("ttft_deadline_ms"))))
+        counts["submitted"] += 1
+        counts["deadlines"] = counts["deadlines"] or bool(
+            item.get("deadline_ms") or item.get("ttft_deadline_ms"))
+        if not streaming:
+            meta.append((item.get("tenant", "default"),
+                         bool(item.get("deadline_ms")
+                              or item.get("ttft_deadline_ms"))))
 
     if isinstance(trace, (list, tuple)):
         for item in trace:
@@ -1140,6 +1413,10 @@ def run_router_trace(router: Router, trace,
                 break
         completions = router.completed
         wall_s = time.perf_counter() - t0
+    if streaming:
+        return _streaming_router_report(router, wall_s,
+                                        counts["submitted"],
+                                        counts["deadlines"])
     total_tokens = int(sum(len(c.tokens) for c in completions))
     tok_ts = {
         rid: np.asarray([ev["ts"] for ev in evs if ev["name"] == "tok"],
@@ -1242,5 +1519,67 @@ def run_router_trace(router: Router, trace,
     if router.autoscaler is not None:
         # elastic-fleet surface: the deterministic scale-event log plus
         # warm/cold spawn counts and scale-up time-to-ready blocks
+        report["autoscale"] = router.autoscaler.report(router)
+    return report
+
+
+def _streaming_router_report(router: Router, wall_s: float,
+                             submitted: int, has_deadlines: bool) -> dict:
+    """Memory-bounded fleet report (``keep_completions=False``): built from
+    the harvest aggregates and the per-replica latency histograms merged
+    bucket-wise — no per-request lists, no tracer (ROADMAP #18)."""
+    agg = router._agg
+    completed = agg["completed"]
+    total_tokens = agg["tokens"]
+    itls = [eng._m_itl for eng in router.engines]
+    ttfts = [eng._m_ttft for eng in router.engines]
+    itl = itls[0].merged(*itls[1:]) if itls else None
+    ttft = ttfts[0].merged(*ttfts[1:]) if ttfts else None
+    rejected = int(router.stats["rejected"])
+    report = {
+        "streaming": True,
+        "percentile_basis": "log-bucket histogram upper edges",
+        "replicas": len(router.engines),
+        "placement": router.placement,
+        "requests_submitted": submitted,
+        "requests_completed": completed,
+        "total_generated_tokens": total_tokens,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_sec": (round(total_tokens / wall_s, 1)
+                           if wall_s > 0 else None),
+        "goodput_tokens_per_sec": (
+            round(agg["ontime_tokens"] / wall_s, 1) if wall_s > 0 else None),
+        # the ROADMAP #18 deliverable: total host wall over completed
+        # requests — with a sim lm there is no device time to hide behind,
+        # so this IS the scheduler+bookkeeping cost per request
+        "sched_overhead_us_per_request": (
+            round(wall_s * 1e6 / completed, 2) if completed else None),
+        "blocks": router.blocks,
+        "rejected": rejected,
+        "expired": agg["expired"],
+        "cancelled": agg["cancelled"],
+        "deadline_miss_rate": (
+            round((rejected + agg["missed"]) / submitted, 4)
+            if has_deadlines and submitted else None),
+        "itl_p50_ms": (round(itl.percentile(50), 3)
+                       if itl is not None and itl.count else None),
+        "itl_p99_ms": (round(itl.percentile(99), 3)
+                       if itl is not None and itl.count else None),
+        "ttft_ms_p99": (round(ttft.percentile(99), 3)
+                        if ttft is not None and ttft.count else None),
+        "ttft_blocks_mean": (round(agg["ttft_blocks_sum"] / completed, 2)
+                             if completed else None),
+        "queue_blocks_mean": (round(agg["queue_blocks_sum"] / completed, 2)
+                              if completed else None),
+        "replica_blocks": router.stats["replica_blocks"],
+        "placements": router.stats["placements"],
+        "affinity_placements": router.stats["affinity_placements"],
+        "requeues": router.stats["requeues"],
+        "crashes": router.stats["crashes"],
+        "failovers": router.stats["failovers"],
+        "drains": router.stats["drains"],
+        "replicas_active": len(router._live_replicas()),
+    }
+    if router.autoscaler is not None:
         report["autoscale"] = router.autoscaler.report(router)
     return report

@@ -1,0 +1,479 @@
+"""Heap-backed scheduler queues: the O(1)/O(log n) hot-path data
+structures behind :class:`ServeEngine` admission and :class:`Router`
+placement (ROADMAP #18 — fleet-scale scheduler performance).
+
+Before this module, every per-block scheduler decision re-derived its
+ordering from scratch: EDF admission re-sorted the whole arrived backlog
+(``_arrived_sorted``), shed victims and queued-deadline expiry scanned the
+queue linearly, WFQ placement sorted the entire router backlog, and the
+counters the bounded-queue/shed logic needs (arrived depth, undelivered
+token budget) were ``sum()`` comprehensions over the backlog. All of that
+is O(queue) PER BLOCK — invisible at thousands-scale traces, the dominant
+host cost at the ROADMAP's 100-replica x 1M-request scale.
+
+Both queues here keep every ordering the old code produced, tie-broken
+identically, with O(log n) membership updates and O(1) counters:
+
+* :class:`AdmissionQueue` — the engine's admission backlog. Entries carry
+  a deque-position token (``appendleft`` allocates positions toward
+  -inf, ``append`` toward +inf), so "stable sort by queue position" —
+  the old EDF tiebreak, which is FIFO by arrival with requeues jumping
+  to the front — is preserved exactly. Separate lazy-deleted heaps serve
+  EDF admission order, the two shed-victim policies ('tail' = newest
+  arrival, 'deadline' = laxest deadline), queued-deadline expiry, and
+  future arrivals (virtual-clock submissions ahead of ``now``).
+* :class:`PendingQueue` — the router's placement backlog. Placement
+  order ((replays-first, WFQ finish tag, request id) — a total order, so
+  no position bookkeeping is needed) rides one heap; arrival/backoff
+  gates ride a second; per-(role, tenant) arrived-cost sums (INTEGER
+  token costs, so incremental maintenance is exact, with the
+  cost/weight division applied once at read time), per-tenant
+  newest-victim heaps for tenant-aware shedding, and the fleet
+  retry-after token sum are all maintained incrementally.
+
+Lazy deletion discipline: removal marks an entry dead in O(1); heap
+entries are validated on pop against the entry's current insertion token
+and re-pushed when merely peeked. Dead entries are reclaimed by
+compaction once they outnumber the live set — amortized O(log n) per
+operation, O(live) resident.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+_INF = float("inf")
+
+
+def admission_deadline(r) -> float:
+    """EDF sort key of one request: the binding deadline for getting
+    ADMITTED — first token (when set), else completion, else never."""
+    if r.ttft_deadline_block is not None:
+        return float(r.ttft_deadline_block)
+    if r.deadline_block is not None:
+        return float(r.deadline_block)
+    return _INF
+
+
+def shed_deadline_key(r) -> Tuple[float, int]:
+    """'deadline' shed-policy victim ordering (max sheds first): laxest
+    effective deadline, deadline-free before any deadline'd one, newest
+    submission on ties."""
+    ttft = _INF if r.ttft_deadline_block is None else r.ttft_deadline_block
+    full = _INF if r.deadline_block is None else r.deadline_block
+    return (min(ttft, full), r.request_id)
+
+
+class AdmissionQueue:
+    """The engine's admission backlog (drop-in for the old ``deque`` of
+    :class:`Request`), with O(log n) admission/shed/expiry and O(1)
+    arrived-depth / token-budget counters. Iteration and ``ordered()``
+    reproduce deque order exactly (position tokens)."""
+
+    def __init__(self):
+        self._req: Dict[int, object] = {}       # rid -> Request
+        self._pos: Dict[int, int] = {}          # rid -> deque-position token
+        self._front = 0                         # next appendleft position - 1
+        self._back = 0                          # next append position
+        self._now = -(10 ** 9)                  # last advanced block
+        self._arrived: Set[int] = set()
+        self._tokens = 0                        # sum max_new_tokens, all live
+        self._future: List[Tuple[int, int, int]] = []   # (arrival, pos, rid)
+        self._edf: List[Tuple[float, int, int]] = []    # (deadline, pos, rid)
+        self._tail: List[Tuple[int, int, int, int]] = []  # (-arr, -rid, pos, rid)
+        self._lax: List[Tuple[float, int, int, int]] = []  # (-dl, -rid, pos, rid)
+        self._exp: List[Tuple[float, int, int]] = []    # (expire_at, pos, rid)
+        self._dead = 0                          # stale heap entries, approx
+
+    # --- deque-compatible mutation ---------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._req)
+
+    def __bool__(self) -> bool:
+        return bool(self._req)
+
+    def __iter__(self) -> Iterator:
+        return iter(self.ordered())
+
+    def ordered(self) -> List:
+        """Live requests in deque order (snapshot/extract surface —
+        O(n log n), never on the block hot path)."""
+        return [self._req[rid] for rid in
+                sorted(self._req, key=self._pos.__getitem__)]
+
+    def append(self, req) -> None:
+        self._insert(req, self._back)
+        self._back += 1
+
+    def appendleft(self, req) -> None:
+        self._front -= 1
+        self._insert(req, self._front)
+
+    def extendleft(self, reqs) -> None:
+        # deque.extendleft semantics: each item lands at the front in
+        # iteration order (so the final front-to-back order is reversed)
+        for r in reqs:
+            self.appendleft(r)
+
+    def _insert(self, req, pos: int) -> None:
+        rid = req.request_id
+        if rid in self._req:
+            raise ValueError(f"request {rid} already queued")
+        self._req[rid] = req
+        self._pos[rid] = pos
+        self._tokens += int(req.max_new_tokens)
+        dls = [d for d in (req.ttft_deadline_block, req.deadline_block)
+               if d is not None]
+        if dls:
+            heapq.heappush(self._exp, (float(min(dls)), pos, rid))
+        if req.arrival_block <= self._now:
+            self._mark_arrived(req, pos)
+        else:
+            heapq.heappush(self._future, (int(req.arrival_block), pos, rid))
+
+    def _mark_arrived(self, req, pos: int) -> None:
+        rid = req.request_id
+        self._arrived.add(rid)
+        heapq.heappush(self._edf, (admission_deadline(req), pos, rid))
+        heapq.heappush(self._tail,
+                       (-int(req.arrival_block), -rid, pos, rid))
+        key = shed_deadline_key(req)
+        heapq.heappush(self._lax, (-key[0], -rid, pos, rid))
+
+    def remove(self, rid: int):
+        """Drop the request by id (O(1) amortized; heap entries go stale
+        and are reclaimed lazily). Returns the request, or None."""
+        req = self._req.pop(int(rid), None)
+        if req is None:
+            return None
+        self._pos.pop(req.request_id, None)
+        self._arrived.discard(req.request_id)
+        self._tokens -= int(req.max_new_tokens)
+        self._dead += 4
+        self._maybe_compact()
+        return req
+
+    def find(self, rid: int):
+        return self._req.get(int(rid))
+
+    def clear(self) -> None:
+        self.__init__()
+
+    # --- clock -----------------------------------------------------------
+
+    def advance(self, now: int) -> None:
+        """Move future submissions whose arrival block has passed into the
+        arrived structures. Monotone — the virtual clock never rewinds."""
+        if now <= self._now:
+            return
+        self._now = int(now)
+        while self._future and self._future[0][0] <= now:
+            _a, pos, rid = heapq.heappop(self._future)
+            if self._pos.get(rid) == pos and rid not in self._arrived:
+                self._mark_arrived(self._req[rid], pos)
+
+    # --- O(1) counters ----------------------------------------------------
+
+    def arrived_count(self, now: int) -> int:
+        self.advance(now)
+        return len(self._arrived)
+
+    def tokens(self) -> int:
+        """Sum of undelivered ``max_new_tokens`` over every queued request
+        (the retry-after estimate's numerator)."""
+        return self._tokens
+
+    # --- ordered reads ----------------------------------------------------
+
+    def _valid(self, pos: int, rid: int) -> bool:
+        return self._pos.get(rid) == pos and rid in self._arrived
+
+    def peek_edf(self, now: int, skip, k: int) -> List:
+        """Up to ``k`` arrived requests in admission order — EDF with the
+        deque position as the FIFO tiebreak, exactly the old
+        ``_arrived_sorted`` prefix — skipping ids in ``skip`` (this
+        admission pass's deferred set). Non-destructive."""
+        self.advance(now)
+        out, popped = [], []
+        h = self._edf
+        while h and len(out) < k:
+            item = heapq.heappop(h)
+            _dl, pos, rid = item
+            if not self._valid(pos, rid):
+                self._dead = max(self._dead - 1, 0)
+                continue
+            popped.append(item)
+            if rid not in skip:
+                out.append(self._req[rid])
+        for item in popped:
+            heapq.heappush(h, item)
+        return out
+
+    def _peek_victim(self, heap, now: int):
+        self.advance(now)
+        while heap:
+            item = heap[0]
+            pos, rid = item[-2], item[-1]
+            if self._valid(pos, rid):
+                return self._req[rid]
+            heapq.heappop(heap)
+            self._dead = max(self._dead - 1, 0)
+        return None
+
+    def peek_tail_victim(self, now: int):
+        """Newest arrived request — the 'tail' shed policy's victim
+        (max (arrival_block, request_id))."""
+        return self._peek_victim(self._tail, now)
+
+    def peek_lax_victim(self, now: int):
+        """Laxest-deadline arrived request — the 'deadline' shed policy's
+        victim (max :func:`shed_deadline_key`)."""
+        return self._peek_victim(self._lax, now)
+
+    def expire_due(self, now: int) -> List:
+        """Remove and return every queued request whose effective deadline
+        has passed (``now > min(ttft, full)``), in deque order — the order
+        the old linear expiry scan produced."""
+        out = []
+        while self._exp and self._exp[0][0] < now:
+            _d, pos, rid = heapq.heappop(self._exp)
+            if self._pos.get(rid) != pos:
+                self._dead = max(self._dead - 1, 0)
+                continue
+            out.append((pos, self._req[rid]))
+            self.remove(rid)
+        out.sort()
+        return [r for _pos, r in out]
+
+    # --- maintenance ------------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        if self._dead <= 64 + 4 * len(self._req):
+            return
+        self._dead = 0
+        live = set(self._req)
+        self._future = [t for t in self._future
+                        if self._pos.get(t[2]) == t[1]
+                        and t[2] not in self._arrived]
+        self._edf = [t for t in self._edf if self._valid(t[1], t[2])]
+        self._tail = [t for t in self._tail if self._valid(t[2], t[3])]
+        self._lax = [t for t in self._lax if self._valid(t[2], t[3])]
+        self._exp = [t for t in self._exp
+                     if t[2] in live and self._pos.get(t[2]) == t[1]]
+        for h in (self._future, self._edf, self._tail, self._lax, self._exp):
+            heapq.heapify(h)
+
+
+class PendingQueue:
+    """The router's placement backlog: entries are ``router._Entry``
+    objects; the placement order ((replay-first, WFQ finish tag, rid)) is a
+    total order so no deque positions are needed. Arrival/backoff gates
+    (``max(arrival_block, not_before)``) ride a future heap; per-(role,
+    tenant) INTEGER arrived-cost sums and per-tenant newest-victim heaps
+    make tenant-aware shedding and the autoscaler's weighted-backlog signal
+    O(1)-per-mutation instead of O(backlog)-per-block."""
+
+    def __init__(self):
+        self._entries: Dict[int, object] = {}
+        self._gen: Dict[int, int] = {}          # rid -> insertion token
+        self._seq = 0
+        self._now = -(10 ** 9)
+        self._future: List[Tuple[int, int, int]] = []
+        self._ready: List[Tuple[Tuple[int, float, int], int, int]] = []
+        self._ready_set: Set[int] = set()
+        # arrived-cost sums (ints — prompt + budget tokens), per role pool
+        # and tenant; the cost/weight division happens at read time so the
+        # sum is exact regardless of mutation history
+        self._cost: Dict[Tuple[str, str], int] = {}
+        self._ready_role: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self._victims: Dict[str, List[Tuple[int, int, int]]] = {}
+        self._pending_tokens = 0                # sum(max_new - delivered)
+        self._n_decode_replay = 0               # replay entries w/ tokens
+        self._dead = 0
+
+    # --- role/cost helpers ------------------------------------------------
+
+    @staticmethod
+    def entry_role(e) -> str:
+        """Which worker pool a pending entry loads: mid-stream replays are
+        decode work, everything else is prefill work (mirrors
+        ``DisaggRouter._viable_replicas``; a classic fleet sums both)."""
+        return "decode" if (e.replay and e.generated) else "prefill"
+
+    @staticmethod
+    def entry_cost(e) -> int:
+        return int(e.req.prompt.size) + int(e.req.max_new_tokens)
+
+    # --- mutation ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __bool__(self) -> bool:
+        return bool(self._entries)
+
+    def __iter__(self) -> Iterator:
+        return iter(list(self._entries.values()))
+
+    def append(self, e) -> None:
+        rid = e.req.request_id
+        if rid in self._entries:
+            raise ValueError(f"entry {rid} already pending")
+        self._seq += 1
+        self._entries[rid] = e
+        self._gen[rid] = self._seq
+        self._pending_tokens += (int(e.req.max_new_tokens)
+                                 - len(e.generated))
+        if e.replay and e.generated:
+            self._n_decode_replay += 1
+        gate = max(int(e.req.arrival_block), int(e.not_before))
+        if gate <= self._now:
+            self._mark_ready(e, self._seq)
+        else:
+            heapq.heappush(self._future, (gate, self._seq, rid))
+
+    # failover/migration re-entries used appendleft on the old deque; the
+    # placement order is key-total, so front/back insertion is equivalent
+    appendleft = append
+
+    def _mark_ready(self, e, seq: int) -> None:
+        rid = e.req.request_id
+        self._ready_set.add(rid)
+        key = (0 if e.replay else 1, float(e.finish_tag), rid)
+        heapq.heappush(self._ready, (key, seq, rid))
+        role = self.entry_role(e)
+        tenant = e.req.tenant
+        self._cost[(role, tenant)] = (self._cost.get((role, tenant), 0)
+                                      + self.entry_cost(e))
+        self._ready_role[role] += 1
+        if not e.replay:
+            heapq.heappush(self._victims.setdefault(tenant, []),
+                           (-rid, seq, rid))
+
+    def remove(self, e) -> None:
+        rid = e.req.request_id if hasattr(e, "req") else int(e)
+        ent = self._entries.pop(rid, None)
+        if ent is None:
+            return
+        self._gen.pop(rid, None)
+        self._pending_tokens -= (int(ent.req.max_new_tokens)
+                                 - len(ent.generated))
+        if ent.replay and ent.generated:
+            self._n_decode_replay -= 1
+        if rid in self._ready_set:
+            self._ready_set.discard(rid)
+            role = self.entry_role(ent)
+            k = (role, ent.req.tenant)
+            left = self._cost.get(k, 0) - self.entry_cost(ent)
+            if left > 0:
+                self._cost[k] = left
+            else:
+                self._cost.pop(k, None)
+            self._ready_role[role] -= 1
+        self._dead += 3
+        self._maybe_compact()
+
+    def get(self, rid: int):
+        return self._entries.get(int(rid))
+
+    # --- clock ------------------------------------------------------------
+
+    def advance(self, now: int) -> None:
+        if now <= self._now:
+            return
+        self._now = int(now)
+        while self._future and self._future[0][0] <= now:
+            _g, seq, rid = heapq.heappop(self._future)
+            if self._gen.get(rid) == seq and rid not in self._ready_set:
+                self._mark_ready(self._entries[rid], seq)
+
+    # --- counters ---------------------------------------------------------
+
+    def ready_count(self, now: int, role: Optional[str] = None) -> int:
+        self.advance(now)
+        if role is None or role == "both":
+            return len(self._ready_set)
+        return self._ready_role.get(role, 0)
+
+    def pending_tokens(self) -> int:
+        return self._pending_tokens
+
+    def fresh_count(self) -> int:
+        """Entries that are prefill work (fresh admissions + zero-token
+        replays) — the disagg liveness check's numerator."""
+        return len(self._entries) - self._n_decode_replay
+
+    def decode_replay_count(self) -> int:
+        return self._n_decode_replay
+
+    def role_tenant_cost(self, role: Optional[str]) -> Dict[str, int]:
+        """Arrived WFQ cost (integer tokens) per tenant for one role pool
+        (None/'both' = both pools) — the autoscaler's weighted-backlog
+        numerator and the tenant-shed usage table, O(tenants) to read."""
+        out: Dict[str, int] = {}
+        for (r, t), c in self._cost.items():
+            if role in (None, "both") or r == role:
+                out[t] = out.get(t, 0) + c
+        return out
+
+    # --- ordered reads ----------------------------------------------------
+
+    def iter_ready(self, now: int):
+        """Yield arrived entries in placement order (replays first, then
+        WFQ finish tags, ids as tiebreak). The caller may ``remove()`` the
+        yielded entry (a placement); everything merely inspected is
+        restored. New entries pushed DURING iteration (requeue backoffs)
+        are gated into the future, never yielded twice."""
+        self.advance(now)
+        popped = []
+        try:
+            while self._ready:
+                item = heapq.heappop(self._ready)
+                _key, seq, rid = item
+                if self._gen.get(rid) != seq or rid not in self._ready_set:
+                    self._dead = max(self._dead - 1, 0)
+                    continue
+                popped.append(item)
+                yield self._entries[rid]
+        finally:
+            for item in popped:
+                if self._gen.get(item[2]) == item[1]:
+                    heapq.heappush(self._ready, item)
+
+    def newest_victim(self, tenant: str):
+        """Newest (max request id) arrived NON-REPLAY entry of ``tenant``
+        — the tenant-over-budget shed victim; None when the tenant has
+        only replay (or no) arrived entries."""
+        h = self._victims.get(tenant)
+        while h:
+            _nr, seq, rid = h[0]
+            if (self._gen.get(rid) == seq and rid in self._ready_set):
+                return self._entries[rid]
+            heapq.heappop(h)
+            self._dead = max(self._dead - 1, 0)
+        return None
+
+    # --- maintenance ------------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        if self._dead <= 64 + 3 * len(self._entries):
+            return
+        self._dead = 0
+        self._future = [t for t in self._future
+                        if self._gen.get(t[2]) == t[1]
+                        and t[2] not in self._ready_set]
+        self._ready = [t for t in self._ready
+                       if self._gen.get(t[2]) == t[1]
+                       and t[2] in self._ready_set]
+        heapq.heapify(self._future)
+        heapq.heapify(self._ready)
+        vic = {}
+        for tenant, h in self._victims.items():
+            keep = [t for t in h if self._gen.get(t[2]) == t[1]
+                    and t[2] in self._ready_set]
+            if keep:
+                heapq.heapify(keep)
+                vic[tenant] = keep
+        self._victims = vic

@@ -179,6 +179,26 @@ class Histogram:
             total += c
         return total
 
+    def merged(self, *others: "Histogram") -> "Histogram":
+        """Fresh histogram holding this one's counts plus ``others``'
+        (bucket-wise — all inputs must share lo/growth/bucket count). The
+        streaming fleet report's percentile source: per-replica latency
+        histograms sum EXPLICITLY into one distribution (engines keep
+        their own registries; nothing sums silently)."""
+        out = Histogram(self.name, self.labels, lo=self.lo,
+                        growth=self.growth, n_buckets=len(self.counts) - 1)
+        for h in (self,) + tuple(others):
+            if (h.lo, h.growth, len(h.counts)) != (
+                    out.lo, out.growth, len(out.counts)):
+                raise ValueError(
+                    f"cannot merge histograms with different bucketing: "
+                    f"{h.name} ({h.lo}/{h.growth}/{len(h.counts)}) vs "
+                    f"{out.name} ({out.lo}/{out.growth}/{len(out.counts)})")
+            out.counts = [a + b for a, b in zip(out.counts, h.counts)]
+            out.sum += h.sum
+            out.count += h.count
+        return out
+
     def percentile(self, q: float) -> Optional[float]:
         """Upper edge of the bucket covering the q-th percentile (None when
         empty). The +Inf bucket reports the largest finite edge."""

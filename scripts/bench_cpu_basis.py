@@ -24,6 +24,14 @@ blocks``) are basis-robust: they live on the virtual block clock or
 divide out the hardware.
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py [out.json]
+
+Incremental section refresh (ISSUE 14): the fleet-scale scheduler soak is
+host-only (sim model, zero XLA), so its keys can be regenerated WITHOUT
+re-running the jax serving sections — merge them into the previous
+baseline instead of paying the full tiny-dims compile sweep:
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --sched-update BENCH_r06.json BENCH_r07.json
 """
 
 from __future__ import annotations
@@ -35,7 +43,46 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _sched_update(base_path: str, out_path: str) -> int:
+    """BENCH_r0(x+1) = BENCH_r0x + freshly measured scheduler-soak keys
+    (host-only — same box, same basis; every jax-section number is carried
+    over verbatim and says so in the wrapper cmd)."""
+    import bench
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+    soak = bench.bench_sched_soak()
+    parsed.update(soak)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | sched-soak keys measured by --sched-update on top of "
+        + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--sched-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in soak if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) >= 4 and sys.argv[1] == "--sched-update":
+        return _sched_update(sys.argv[2], sys.argv[3])
+
     import jax.numpy as jnp
 
     import bench

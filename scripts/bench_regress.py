@@ -83,6 +83,14 @@ RULES: List[Tuple[str, str, float]] = [
     # noise); the structured-vs-freeform ITL ratio is higher-better (the
     # in-scan mask must not stall the pool); grammar compile is a one-time
     # host cost, noisy on a shared box
+    # fleet-scale scheduler soak (ISSUE 14): host wall us per request on
+    # a shared 1-core box is noisy — generous tolerance; the RATIO (1M vs
+    # 1k scale) is the sub-linearity claim and moves only with algorithmic
+    # regressions, so it gates tighter; the RSS slope is clamped >= 0 at
+    # the source and gates on absolute-ish growth
+    (r"router_sched_overhead_scaling_ratio", "lower", 0.25),
+    (r"router_sched_overhead_us_per_request(_\w+)?", "lower", 0.35),
+    (r"soak_rss_mb_per_100k_requests", "lower", 1.00),
     (r"serve_structured_parse_rate", "higher", 0.0),
     (r"serve_itl_p50_ms_structured_vs_freeform", "higher", 0.10),
     (r"grammar_compile_ms", "lower", 0.50),

@@ -98,6 +98,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_itl_p50_ms_freeform": 6.28,
                                       "serve_structured_requests": 6,
                                       "grammar_bytes_per_slot": 15360000,
+                                      "router_sched_overhead_us_per_request": 62.0,
+                                      "router_sched_overhead_us_per_request_1k": 55.0,
+                                      "router_sched_overhead_us_per_request_100k": 60.0,
+                                      "router_sched_overhead_scaling_ratio": 1.13,
+                                      "soak_rss_mb_per_100k_requests": 0.0,
+                                      "soak_rss_mb_peak": 145.2,
                                       "serve_tracing_overhead_ratio": 0.993,
                                       "serve_tokens_per_sec_traced": 508.4,
                                       "serve_tokens_per_sec_untraced": 512.0,
@@ -141,7 +147,9 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     # serving keys (ISSUE 2) ride both surfaces
     assert d["serve_tokens_per_sec_cb"] == h["serve_tokens_per_sec_cb"] == 512.0
     assert h["serve_insert_ms_1slot"] == 21.0
-    assert h["serve_insert_ms_1slot"] < h["serve_insert_fullwidth_ms_1slot"]
+    # the full-width contrast basis is sidecar-only since ISSUE 14
+    assert h["serve_insert_ms_1slot"] < d["serve_insert_fullwidth_ms_1slot"]
+    assert "serve_insert_fullwidth_ms_1slot" not in h
     assert h["serve_fused_round_device_ms"] == 130.0
     # paged serving keys (ISSUE 3): prefix-hit TTFT must undercut cold TTFT
     # on both surfaces, and the HBM ratio rides the headline
@@ -155,7 +163,8 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     # beating the one-shot insert on both the p99 and the stall
     assert d["serve_itl_p99_ms"] == h["serve_itl_p99_ms"] == 9.8
     assert h["serve_itl_p50_ms"] == 6.2
-    assert h["serve_itl_p99_ms"] < h["serve_itl_p99_ms_unchunked"]
+    assert h["serve_itl_p99_ms"] < d["serve_itl_p99_ms_unchunked"]
+    assert "serve_itl_p99_ms_unchunked" not in h
     assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
     assert h["serve_decode_stall_ms_longprompt_chunked"] < \
         h["serve_decode_stall_ms_longprompt"]
@@ -509,6 +518,98 @@ def test_bench_regress_committed_r06_gates_serving_keys(tmp_path):
     assert rc == 1
     assert [r["key"] for r in summary["regressions"]] == \
         ["serve_goodput_2x_overload"]
+
+
+def test_report_sched_soak_keys(monkeypatch, capsys, tmp_path):
+    """ISSUE 14 satellite: the fleet-scale scheduler soak keys ride the
+    headline (mocked serving section) — the scaling curve's endpoints,
+    the sub-linearity ratio and the RSS leak slope all surface, and the
+    ratio/slope are the gate-bearing quantities."""
+    d, h = _run_main(monkeypatch, capsys, tmp_path,
+                     {1: 0.263, 2: 0.463, 3: 0.663, 4: 0.863})
+    for key in ("router_sched_overhead_us_per_request",
+                "router_sched_overhead_scaling_ratio",
+                "soak_rss_mb_per_100k_requests"):
+        assert key in h, key
+        assert h[key] == d[key]
+    # the full curve stays in the SIDECAR (headline is size-capped)
+    for key in ("router_sched_overhead_us_per_request_1k",
+                "router_sched_overhead_us_per_request_100k"):
+        assert key in d and key not in h
+    assert h["router_sched_overhead_scaling_ratio"] < 3.0
+    assert h["soak_rss_mb_per_100k_requests"] >= 0.0
+
+
+def test_bench_regress_sched_soak_direction_rules(tmp_path):
+    """Direction-of-goodness for the soak keys: a RISING per-request
+    overhead, scaling ratio, or RSS slope regresses (lower-is-better all
+    three); the overhead keys get the generous shared-box tolerance, the
+    ratio the tight algorithmic one."""
+    keys = ["router_sched_overhead_us_per_request",
+            "router_sched_overhead_scaling_ratio"]
+    base = {"headline_keys": keys,
+            "router_sched_overhead_us_per_request": 60.0,
+            "router_sched_overhead_scaling_ratio": 1.1}
+    worse = {"headline_keys": keys,
+             "router_sched_overhead_us_per_request": 60.0,
+             "router_sched_overhead_scaling_ratio": 2.5}
+    noisy = {"headline_keys": keys,
+             "router_sched_overhead_us_per_request": 72.0,
+             "router_sched_overhead_scaling_ratio": 1.1}
+    blown = {"headline_keys": keys,
+             "router_sched_overhead_us_per_request": 140.0,
+             "router_sched_overhead_scaling_ratio": 1.1}
+    for name, doc in (("base", base), ("worse", worse), ("noisy", noisy),
+                      ("blown", blown)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "worse.json")
+    assert rc == 1
+    assert [r["key"] for r in summary["regressions"]] == \
+        ["router_sched_overhead_scaling_ratio"]
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "noisy.json")
+    assert rc == 0, "20% wall noise must not gate"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "blown.json")
+    assert rc == 1
+    assert [r["key"] for r in summary["regressions"]] == \
+        ["router_sched_overhead_us_per_request"]
+
+
+def test_bench_regress_committed_r07_gates_sched_keys(tmp_path):
+    """ISSUE 14 satellite: BENCH_r07 (scripts/bench_cpu_basis.py
+    --sched-update over r06) carries the fleet-scale scheduler keys with
+    the measured sub-linear curve; r07 vs itself passes, r06 -> r07
+    reports the sched keys as new_key (never gating), and an injected
+    scaling-ratio regression exits 1 naming the key."""
+    doc = json.loads((REPO / "BENCH_r07.json").read_text())
+    assert doc["rc"] == 0 and "--sched-update" in doc["cmd"]
+    p = doc["parsed"]
+    for key in ("router_sched_overhead_us_per_request",
+                "router_sched_overhead_us_per_request_1k",
+                "router_sched_overhead_us_per_request_100k",
+                "router_sched_overhead_scaling_ratio",
+                "soak_rss_mb_per_100k_requests"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    # the acceptance criteria, pinned on the committed artifact: the 1M
+    # overhead within 3x of 1k (sub-linear curve) and a flat RSS slope
+    assert p["router_sched_overhead_scaling_ratio"] < 3.0
+    assert p["soak_rss_mb_per_100k_requests"] < 2.0
+    assert "sched_soak_curve" in p and "1000000" in p["sched_soak_curve"]
+    rc, summary, err = _regress(REPO / "BENCH_r07.json",
+                                REPO / "BENCH_r07.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    rc, summary, _ = _regress(REPO / "BENCH_r06.json",
+                              REPO / "BENCH_r07.json")
+    assert rc == 0, "new sched keys must land as new_key, never gate"
+    bad = dict(doc, parsed=dict(
+        p, router_sched_overhead_scaling_ratio=
+        p["router_sched_overhead_scaling_ratio"] * 2.5))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r07.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert "router_sched_overhead_scaling_ratio" in \
+        [r["key"] for r in summary["regressions"]]
 
 
 def test_bench_regress_autoscale_direction_rules(tmp_path):

@@ -1,0 +1,444 @@
+"""Fleet-scale scheduler suite (ISSUE 14): the heap-backed admission/
+placement queues must make the SAME decisions the old sort/scan code made
+(model-based equivalence against naive reference implementations of the
+historic semantics), the host-only sim engine must reproduce the real
+engine's SCHEDULE exactly (sim-vs-real block accounting on one trace),
+streaming reports must agree with retained reports, and the 100k/1M soaks
+must hold host RSS flat.
+
+Cost discipline: everything here except the two real-model cross-checks is
+pure host work (no XLA); the real-model tests share ONE module-scoped tiny
+lm. The full 1M x 100-replica soak is @slow; tier-1 runs a 100k streamed
+smoke with an RSS ceiling assertion.
+"""
+
+import random
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+from neuronx_distributed_tpu.inference.engine import (
+    Request,
+    run_trace,
+    synthetic_trace,
+    synthetic_trace_stream,
+)
+from neuronx_distributed_tpu.inference.router import Router, run_router_trace
+from neuronx_distributed_tpu.inference.schedq import (
+    AdmissionQueue,
+    PendingQueue,
+    admission_deadline,
+    shed_deadline_key,
+)
+from neuronx_distributed_tpu.inference.simlm import SimCausalLM
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+import soak as soak_mod  # noqa: E402
+
+
+# --------------------------------------------------------------- references
+
+def _req(rid, arrival=0, ttft=None, full=None, max_new=8):
+    return Request(request_id=rid, prompt=np.ones((4,), np.int32),
+                   max_new_tokens=max_new, arrival_block=arrival,
+                   ttft_deadline_block=ttft, deadline_block=full)
+
+
+class NaiveAdmission:
+    """The OLD deque semantics, verbatim: linear scans and full re-sorts.
+    The model oracle the heap queue must match decision-for-decision."""
+
+    def __init__(self):
+        self.q = []
+
+    def append(self, r):
+        self.q.append(r)
+
+    def appendleft(self, r):
+        self.q.insert(0, r)
+
+    def extendleft(self, rs):
+        for r in rs:
+            self.q.insert(0, r)
+
+    def remove(self, rid):
+        for i, r in enumerate(self.q):
+            if r.request_id == rid:
+                del self.q[i]
+                return r
+        return None
+
+    def arrived(self, now):
+        return [r for r in self.q if r.arrival_block <= now]
+
+    def edf(self, now, skip, k):
+        arr = [(i, r) for i, r in enumerate(self.q)
+               if r.arrival_block <= now]
+        arr.sort(key=lambda ir: (admission_deadline(ir[1]), ir[0]))
+        return [r for _i, r in arr if r.request_id not in skip][:k]
+
+    def tail_victim(self, now):
+        arr = self.arrived(now)
+        return max(arr, key=lambda r: (r.arrival_block, r.request_id)) \
+            if arr else None
+
+    def lax_victim(self, now):
+        arr = self.arrived(now)
+        return max(arr, key=shed_deadline_key) if arr else None
+
+    def expire_due(self, now):
+        out = [r for r in self.q
+               if (r.ttft_deadline_block is not None
+                   and now > r.ttft_deadline_block)
+               or (r.deadline_block is not None
+                   and now > r.deadline_block)]
+        for r in out:
+            self.q.remove(r)
+        return out
+
+    def tokens(self):
+        return sum(r.max_new_tokens for r in self.q)
+
+
+def test_admission_queue_matches_naive_model():
+    """Randomized op-sequence equivalence: EDF order, both shed-victim
+    policies, queued-deadline expiry, arrived/token counters and deque
+    iteration order all match the naive reference exactly — the
+    'old-vs-new scheduler' pin at the data-structure level."""
+    rng = random.Random(7)
+    for trial in range(5):
+        q, ref = AdmissionQueue(), NaiveAdmission()
+        now, next_rid = 0, 0
+        removed = []
+        for _op in range(300):
+            op = rng.random()
+            if op < 0.35:
+                r = _req(next_rid,
+                         arrival=now + rng.randint(0, 6),
+                         ttft=(now + rng.randint(1, 20)
+                               if rng.random() < 0.4 else None),
+                         full=(now + rng.randint(2, 30)
+                               if rng.random() < 0.4 else None),
+                         max_new=rng.randint(1, 16))
+                next_rid += 1
+                q.append(r)
+                ref.append(r)
+            elif op < 0.45 and removed:
+                r = removed.pop(rng.randrange(len(removed)))
+                q.appendleft(r)
+                ref.appendleft(r)
+            elif op < 0.55 and len(ref.q):
+                victim = rng.choice(ref.q)
+                got = q.remove(victim.request_id)
+                ref.remove(victim.request_id)
+                assert got is victim
+                removed.append(victim)
+            elif op < 0.65:
+                now += rng.randint(0, 3)
+                q.advance(now)
+                expired = q.expire_due(now)
+                ref_expired = ref.expire_due(now)
+                assert [r.request_id for r in expired] == \
+                    [r.request_id for r in ref_expired], trial
+            else:
+                skip = {r.request_id for r in
+                        rng.sample(ref.q, min(2, len(ref.q)))} \
+                    if ref.q and rng.random() < 0.3 else set()
+                k = rng.randint(1, 5)
+                assert [r.request_id for r in q.peek_edf(now, skip, k)] == \
+                    [r.request_id for r in ref.edf(now, skip, k)], trial
+                tv, rtv = q.peek_tail_victim(now), ref.tail_victim(now)
+                assert (tv is None) == (rtv is None)
+                if tv is not None:
+                    assert tv.request_id == rtv.request_id
+                lv, rlv = q.peek_lax_victim(now), ref.lax_victim(now)
+                if lv is not None:
+                    assert lv.request_id == rlv.request_id
+            assert len(q) == len(ref.q)
+            assert q.arrived_count(now) == len(ref.arrived(now))
+            assert q.tokens() == ref.tokens()
+            assert [r.request_id for r in q.ordered()] == \
+                [r.request_id for r in ref.q]
+
+
+class _E:
+    """Minimal _Entry-shaped record for the pending-queue model test."""
+
+    def __init__(self, req, finish_tag, not_before=0, replay=False,
+                 generated=()):
+        self.req = req
+        self.finish_tag = finish_tag
+        self.not_before = not_before
+        self.replay = replay
+        self.generated = list(generated)
+        self.v_start = 0.0
+
+
+def test_pending_queue_matches_naive_model():
+    """Randomized equivalence for the router backlog: placement order
+    (replays-first, WFQ finish tags, rid tiebreak), arrival/backoff
+    gating, per-tenant arrived-cost sums and newest-victim selection all
+    match the naive full-scan reference."""
+    rng = random.Random(13)
+    for trial in range(5):
+        pq, ref = PendingQueue(), []
+        now, next_rid = 0, 0
+        for _op in range(300):
+            op = rng.random()
+            if op < 0.45:
+                r = _req(next_rid, arrival=now + rng.randint(0, 4),
+                         max_new=rng.randint(1, 12))
+                r.tenant = f"t{rng.randint(0, 3)}"
+                replay = rng.random() < 0.2
+                e = _E(r, finish_tag=round(rng.random() * 50, 3),
+                       not_before=now + rng.randint(0, 5),
+                       replay=replay,
+                       generated=[1] * rng.randint(1, 4)
+                       if replay and rng.random() < 0.7 else [])
+                next_rid += 1
+                pq.append(e)
+                ref.append(e)
+            elif op < 0.6 and ref:
+                e = rng.choice(ref)
+                pq.remove(e)
+                ref.remove(e)
+            else:
+                now += rng.randint(0, 3)
+            pq.advance(now)
+
+            def ready(e):
+                return max(e.req.arrival_block, e.not_before) <= now
+
+            got = [e.req.request_id for e in pq.iter_ready(now)]
+            want = [e.req.request_id for e in sorted(
+                (e for e in ref if ready(e)),
+                key=lambda e: (not e.replay, e.finish_tag,
+                               e.req.request_id))]
+            assert got == want, trial
+            cost = {}
+            for e in ref:
+                if ready(e):
+                    cost[e.req.tenant] = cost.get(e.req.tenant, 0) + \
+                        int(e.req.prompt.size + e.req.max_new_tokens)
+            assert pq.role_tenant_cost(None) == cost
+            assert pq.ready_count(now) == sum(1 for e in ref if ready(e))
+            assert pq.pending_tokens() == sum(
+                e.req.max_new_tokens - len(e.generated) for e in ref)
+            assert pq.fresh_count() == sum(
+                1 for e in ref if not (e.replay and e.generated))
+            for t in {e.req.tenant for e in ref}:
+                v = pq.newest_victim(t)
+                cands = [e for e in ref
+                         if ready(e) and e.req.tenant == t and not e.replay]
+                want_v = (max(cands, key=lambda e: e.req.request_id)
+                          if cands else None)
+                assert (v is None) == (want_v is None)
+                if v is not None:
+                    assert v.req.request_id == want_v.req.request_id
+
+
+# ------------------------------------------------- sim-vs-real schedule pin
+
+TINY = dict(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, kv_size_multiplier=1, max_seq_len=64,
+    dtype=jnp.float32, use_flash_attention=False, remat_policy=None,
+)
+
+
+@pytest.fixture(scope="module")
+def real_lm():
+    cfg = LlamaConfig(**TINY, page_size=4, page_pool_pages=40)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(
+        LlamaForCausalLM(cfg).init(jax.random.PRNGKey(0), ids))["params"]
+    return CausalLM(cfg, params, LlamaForCausalLM, buckets=(8, 16),
+                    max_batch=3).compile()
+
+
+def _trace(n=16, **kw):
+    return synthetic_trace(n, 127, prompt_lens=(6, 10), max_new_tokens=7,
+                           mean_interarrival_blocks=0.5, seed=3, **kw)
+
+
+def test_sim_engine_schedule_matches_real_engine(real_lm):
+    """The sim lm's whole claim: identical slot/page accounting ==
+    identical SCHEDULE. The same trace through a real paged engine and a
+    SimCausalLM engine (same buckets/slots/pool) produces the same
+    per-request admission/first-token/retire blocks and the same block
+    totals — so a soak's scheduler numbers describe the real control
+    plane, not a toy."""
+    sim = SimCausalLM(max_batch=3, buckets=(8, 16), max_seq_len=64,
+                      vocab_size=128, page_size=4, page_pool_pages=40)
+    reports = {}
+    scheds = {}
+    for name, lm in (("real", real_lm), ("sim", sim)):
+        eng = ServeEngine(lm, block_steps=4, rng=jax.random.key(1))
+        reports[name] = run_trace(eng, _trace())
+        scheds[name] = sorted(
+            (c.request_id, c.queue_blocks, c.ttft_blocks, c.decode_blocks,
+             len(c.tokens))
+            for c in eng.completed)
+    assert scheds["real"] == scheds["sim"]
+    for k in ("blocks", "decode_blocks", "inserts", "inserted_requests",
+              "requests_completed", "total_generated_tokens",
+              "host_ops_per_block"):
+        assert reports["real"][k] == reports["sim"][k], k
+
+
+def test_sim_engine_never_touches_xla(monkeypatch):
+    """'Million-request runs never execute XLA': a sim engine trace with
+    jax dispatch fenced off completes anyway."""
+    def boom(*a, **kw):
+        raise AssertionError("sim path called into jax")
+
+    sim = SimCausalLM(max_batch=4, buckets=(8, 16), max_seq_len=64,
+                      page_size=4, page_pool_pages=64)
+    eng = ServeEngine(sim, block_steps=8, keep_completions=False)
+    monkeypatch.setattr(jax, "jit", boom)
+    monkeypatch.setattr(jax.random, "fold_in", boom)
+    monkeypatch.setattr(jnp, "asarray", boom)
+    rep = run_trace(eng, synthetic_trace_stream(
+        300, 32000, prompt_lens=(6, 10), max_new_tokens=8,
+        mean_interarrival_blocks=0.1, seed=2))
+    assert rep["streaming"] and rep["requests_completed"] == 300
+
+
+# ------------------------------------------------- streaming report parity
+
+def _sim_router(replicas=4, **kw):
+    lm = SimCausalLM(max_batch=4, buckets=(8, 16), max_seq_len=64,
+                     page_size=4, page_pool_pages=64)
+    return Router(lm, replicas, placement="least_loaded",
+                  block_steps=8, **kw)
+
+
+def test_streaming_router_report_matches_retained():
+    """keep_completions=False must change MEMORY, not outcomes: same
+    trace, same completion/token/shed counts as the retained run, empty
+    completion lists, and histogram-basis percentiles present."""
+    def trace():
+        return synthetic_trace_stream(
+            400, 32000, prompt_lens=(6, 10), max_new_tokens=8,
+            mean_interarrival_blocks=0.05, seed=5)
+
+    r_keep = _sim_router(keep_completions=True)
+    rep_keep = run_router_trace(r_keep, trace())
+    r_str = _sim_router(keep_completions=False, record_block_wall=False)
+    rep_str = run_router_trace(r_str, trace())
+    assert rep_str["streaming"] is True
+    assert rep_str["requests_completed"] == \
+        rep_keep["requests_completed"] == 400
+    assert rep_str["total_generated_tokens"] == \
+        rep_keep["total_generated_tokens"]
+    assert rep_str["blocks"] == rep_keep["blocks"]
+    assert rep_str["placements"] == rep_keep["placements"]
+    # memory bound: nothing materialized per request
+    assert r_str.completed == [] and r_str.rejected == []
+    assert all(not eng.completed for eng in r_str.engines)
+    assert not r_str._eng_block_wall[0]
+    assert rep_str["itl_p50_ms"] is not None
+    assert rep_str["sched_overhead_us_per_request"] > 0
+    # the retained path keeps its full surface
+    assert len(r_keep.completed) == 400
+
+
+def test_sim_failover_streams_exact():
+    """The rng-contract analogue for sim streams: token t of request r is
+    a pure function of (r, t), so a replica crash + failover must deliver
+    every stream bit-identical to the sim token function — proving the
+    incremental delivery-record refresh feeds failover correctly."""
+    lm = SimCausalLM(max_batch=2, buckets=(8, 16), max_seq_len=64,
+                     page_size=4, page_pool_pages=48)
+    router = Router(lm, 2, placement="least_loaded", block_steps=4,
+                    heartbeat_miss_blocks=1, crash_at=((3, 1),))
+    trace = synthetic_trace(10, 32000, prompt_lens=(6,), max_new_tokens=12,
+                            mean_interarrival_blocks=0.3, seed=9)
+    rep = run_router_trace(router, trace)
+    assert rep["requests_completed"] == 10
+    assert router.stats["failovers"] == 1
+    for c in router.completed:
+        want = [lm.sim_token(c.request_id, t) for t in range(len(c.tokens))]
+        assert c.tokens.tolist() == want, c.request_id
+        assert len(c.tokens) == 12
+
+
+def test_router_overload_matrix_fused_stepwise_identical(real_lm):
+    """The old-vs-new scheduler pin at the system level: a tenant-skewed,
+    deadline-carrying, shed-and-requeue-heavy trace through the
+    heap-backed router must produce the IDENTICAL outcome in fused and
+    stepwise mode (greedy and sampled rows mixed) — same completions
+    token-for-token, same shed verdicts, same expiry set. Any ordering
+    drift in the EDF/WFQ/shed heaps versus the historic sorts would split
+    the two schedules apart here."""
+    def run(fused):
+        router = Router(real_lm, 2, placement="affinity",
+                        max_pending=4, tenant_weights={"t0": 2.0},
+                        block_steps=4, fused=fused, max_queue=2,
+                        shed_policy="deadline",
+                        rng=jax.random.key(7))
+        trace = synthetic_trace(
+            16, 127, prompt_lens=(6, 10), max_new_tokens=6,
+            mean_interarrival_blocks=0.06, tenants=3, tenant_skew=1.2,
+            deadline_ms=12.0, ttft_deadline_ms=6.0, seed=21)
+        # a sampled row rides along (per-request rng contract keeps it
+        # schedule-independent)
+        from neuronx_distributed_tpu.inference import Sampler
+        router.submit(np.asarray([3, 5, 7, 9, 11, 13], np.int32), 6,
+                      sampler=Sampler(temperature=0.9), tenant="t1")
+        rep = run_router_trace(router, trace)
+        comps = sorted((c.request_id, c.tokens.tolist(), c.expired,
+                        c.deadline_missed, c.finish_reason)
+                       for c in router.completed)
+        rejs = sorted((r.request_id, r.reason) for r in router.rejected)
+        return comps, rejs, rep["blocks"], router.stats["requeues"]
+
+    a, b = run(True), run(False)
+    assert a[0] == b[0]          # completions bit-identical
+    assert a[1] == b[1]          # shed verdicts identical
+    assert a[2] == b[2] and a[3] == b[3]
+    # the scenario actually exercised the machinery it claims to pin
+    assert a[1] or any(c[2] for c in a[0]) or any(c[3] for c in a[0])
+
+
+# ------------------------------------------------------------------- soaks
+
+def test_sched_smoke_100k_streamed_rss_bounded():
+    """Tier-1 smoke (ISSUE 14 acceptance): 100k streamed requests through
+    a 10-replica sim fleet in streaming mode — every request completes,
+    the report is histogram-based, and host RSS stays under a hard
+    ceiling (the leak assertion at tier-1 scale)."""
+    rss0 = soak_mod.rss_mb()
+    rep = soak_mod.run_soak(100_000, replicas=8, max_new_tokens=4,
+                            load=0.9)
+    assert rep["requests_completed"] == 100_000
+    assert rep["streaming"] is True
+    assert rep["router_sched_overhead_us_per_request"] < 2000
+    growth = rep["rss_mb_end"] - max(rss0, rep["rss_mb_start"] - 1e9)
+    assert rep["rss_mb_end"] - rss0 < 120, (rss0, rep["rss_mb_end"])
+    slope = rep["rss_mb_per_100k_requests"]
+    assert slope is not None and slope < 8.0, slope
+    del growth
+
+
+@pytest.mark.slow
+def test_soak_1m_rss_flat_and_sublinear():
+    """The full ISSUE 14 acceptance: 100 replicas x 1M virtual-clock
+    requests completes with host RSS non-growing over the final 80% of
+    the run (least-squares slope ~0) and per-request scheduler overhead
+    at 1M within 3x of the 1k-scale value."""
+    small = soak_mod.run_soak(1_000, replicas=100)
+    big = soak_mod.run_soak(1_000_000, replicas=100)
+    assert big["requests_completed"] == 1_000_000
+    slope = big["rss_mb_per_100k_requests"]
+    assert slope is not None and slope < 2.0, slope
+    ratio = (big["router_sched_overhead_us_per_request"]
+             / small["router_sched_overhead_us_per_request"])
+    assert ratio < 3.0, ratio

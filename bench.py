@@ -1855,17 +1855,66 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
     except Exception as e:  # noqa: BLE001 — multilora section additive, never fatal
         out["serve_multilora_error"] = f"{type(e).__name__}: {e}"[:120]
 
-    # --- structured decoding (ISSUE 13 tentpole evidence). Three claims:
-    # (a) serve_structured_parse_rate — every constrained completion
-    #     fullmatches its grammar (regex walk / json.loads): MUST be 1.0,
-    #     by construction (budget-aware token-DFA masking inside the scan);
-    # (b) serve_itl_p50_ms_structured_vs_freeform — a mixed 50% structured
-    #     trace holds >= 0.9x the free-form-only ITL on the same pool: the
-    #     per-step mask (two gathers + a where, inside the compiled scan)
-    #     must not stall decode;
-    # (c) grammar_compile_ms — the one-time host cost of regex/schema ->
-    #     token-DFA compilation over the 32k vocab (amortized over every
-    #     request that ever pins the grammar).
+    # --- structured decoding (ISSUE 13 tentpole evidence): factored out
+    # as bench_structured() so scripts/bench_cpu_basis.py
+    # --structured-update can refresh JUST these keys over a committed
+    # baseline (ISSUE 15 bench-surface audit: r06/r07 predate PR 13, so
+    # the structured headline keys were absent from every committed
+    # serving artifact and therefore never gated)
+    out.update(bench_structured(lcfg, model.params, prompt_len=prompt_len,
+                                max_batch=max_batch,
+                                fused_steps=fused_steps))
+
+
+    # --- fleet-scale scheduler soak (ROADMAP #18, ISSUE 14 tentpole):
+    # 100 sim replicas x 1k/100k/1M virtual-clock requests through the
+    # FULL Router/ServeEngine control plane with a host-only stub model
+    # (inference/simlm.py — zero XLA, real page/slot accounting) in
+    # streaming mode. The deliverable is the SCALING CURVE: us of host
+    # wall per completed request at each scale, which the heap-backed
+    # scheduler (inference/schedq.py) must keep flat — the 1M/1k ratio is
+    # the sub-linearity gate — plus the RSS leak slope over the final 80%
+    # of the 1M run (~0 when every per-request structure is bounded).
+    out.update(bench_sched_soak())
+
+    # compile-vs-execute split (ISSUE 6 satellite): first-call XLA compile
+    # wall ms per program signature, recorded by CausalLM._time_compile —
+    # sidecar-only (a dict of long keys has no place in the headline)
+    out["compile_ms_by_program"] = dict(lm.compile_ms)
+
+    del lm, model, session, fused, st, cache
+    gc.collect()
+    return out
+
+
+
+def bench_structured(lcfg, params, prompt_len=128, max_batch=4,
+                     fused_steps=16) -> dict:
+    """Structured-decoding serving section (ISSUE 13 tentpole evidence),
+    factored out of bench_serving (ISSUE 15) so the CPU-basis baseline
+    driver can refresh JUST these keys over a committed artifact without
+    re-paying the full tiny-dims compile sweep. Three claims:
+
+    * ``serve_structured_parse_rate`` — every constrained completion
+      fullmatches its grammar (regex walk / json.loads): MUST be 1.0, by
+      construction (budget-aware token-DFA masking inside the scan);
+    * ``serve_itl_p50_ms_structured_vs_freeform`` — a mixed 50%
+      structured trace holds >= 0.9x the free-form-only ITL on the same
+      pool: the per-step mask (two gathers + a where, inside the
+      compiled scan) must not stall decode;
+    * ``grammar_compile_ms`` — the one-time host cost of regex/schema ->
+      token-DFA compilation over the 32k vocab (amortized over every
+      request that ever pins the grammar).
+
+    Takes the serving model's ``(lcfg, params)`` — it builds its own
+    grammar-tailed and grammarless CausalLM pools, so any dims work
+    (bench_serving passes 13B layer dims; bench_cpu_basis tiny dims).
+    """
+    from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+    from neuronx_distributed_tpu.inference.engine import run_trace, synthetic_trace
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    out = {}
     try:
         from neuronx_distributed_tpu.inference.grammar import (
             json_schema_to_regex as _js2re,  # noqa: F401 (import check)
@@ -1883,7 +1932,7 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
                 "name": {"type": "string"}, "count": {"type": "integer"},
                 "ok": {"type": "boolean"}}}},
         }
-        lm_g = CausalLM(lcfg, model.params, LlamaForCausalLM,
+        lm_g = CausalLM(lcfg, params, LlamaForCausalLM,
                         buckets=(prompt_len,), max_batch=max_batch,
                         grammar_slots=len(gr_specs) + 1, grammar_states=96)
         lm_g.compile()
@@ -1939,7 +1988,7 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
         # free-form baseline: the identical trace, labels stripped, on a
         # pool compiled WITHOUT grammar support (the bitwise-identity
         # oracle's reference programs)
-        lm_gf = CausalLM(lcfg, model.params, LlamaForCausalLM,
+        lm_gf = CausalLM(lcfg, params, LlamaForCausalLM,
                          buckets=(prompt_len,), max_batch=max_batch)
         lm_gf.compile()
         _eng_f, rep_f = gr_run(lm_gf, labeled=False)
@@ -1961,25 +2010,6 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
         del lm_g, lm_gf, eng_g, _eng_f, gpool
     except Exception as e:  # noqa: BLE001 — structured section additive, never fatal
         out["serve_structured_error"] = f"{type(e).__name__}: {e}"[:120]
-
-    # --- fleet-scale scheduler soak (ROADMAP #18, ISSUE 14 tentpole):
-    # 100 sim replicas x 1k/100k/1M virtual-clock requests through the
-    # FULL Router/ServeEngine control plane with a host-only stub model
-    # (inference/simlm.py — zero XLA, real page/slot accounting) in
-    # streaming mode. The deliverable is the SCALING CURVE: us of host
-    # wall per completed request at each scale, which the heap-backed
-    # scheduler (inference/schedq.py) must keep flat — the 1M/1k ratio is
-    # the sub-linearity gate — plus the RSS leak slope over the final 80%
-    # of the 1M run (~0 when every per-request structure is bounded).
-    out.update(bench_sched_soak())
-
-    # compile-vs-execute split (ISSUE 6 satellite): first-call XLA compile
-    # wall ms per program signature, recorded by CausalLM._time_compile —
-    # sidecar-only (a dict of long keys has no place in the headline)
-    out["compile_ms_by_program"] = dict(lm.compile_ms)
-
-    del lm, model, session, fused, st, cache
-    gc.collect()
     return out
 
 

@@ -1,0 +1,26 @@
+"""nxdcheck: stdlib-only static contract checker for the serving stack.
+
+Usage (programmatic)::
+
+    from neuronx_distributed_tpu.analysis import ALL_RULES, run_checks
+    findings = run_checks(repo_root, ALL_RULES, waiver_file=...)
+
+or via the CLI: ``python scripts/nxdcheck.py --json``.
+
+NO jax import anywhere under this package — it must run in a bare
+container in seconds and is wired into tier-1.
+"""
+
+from .core import Finding, RepoCtx, Rule, run_checks  # noqa: F401
+from . import (determinism, host_sync, replication,  # noqa: F401
+               resource_pairing, surface_drift)
+
+ALL_RULES = (
+    host_sync.RULE,
+    replication.RULE,
+    resource_pairing.RULE,
+    determinism.RULE,
+    surface_drift.RULE,
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

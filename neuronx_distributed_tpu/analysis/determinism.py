@@ -1,0 +1,186 @@
+"""Rule ``determinism``: scheduling and placement decisions live on the
+virtual block clock and seeded rng streams — never on wall entropy.
+
+The replay guarantees this repo sells — (trace, policy, seed) replays the
+identical scale-event log, chaos plans replay twice identical, failover
+streams bit-identical — all assume decision code never reads:
+
+* **wall clock**: ``time.time()`` / ``datetime.now()`` — virtual block
+  quantities only (``time.perf_counter`` stays legal: it feeds the
+  wall-ms *measurement* sidecars, never a decision);
+* **unseeded rng**: module-level ``random.*`` / ``np.random.*`` draws
+  (process-global state). Seeded instances — ``random.Random(seed)``,
+  ``np.random.RandomState(seed)``, ``default_rng(seed)`` — are the
+  blessed pattern;
+* **bare-set iteration**: ``for x in some_set`` in decision code.
+  String hashing is salted per process, so iteration order differs
+  between the run and its replay; even int sets make order a function of
+  insertion history. Order-free reductions (``len`` / ``min`` / ``max``
+  / ``sum`` / ``any`` / ``all`` / membership) are fine; ordered
+  consumption must go through ``sorted(...)``.
+
+Perimeter: observability/bench/example/script code reports wall time by
+design and is allowlisted; everything else in the package gates.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from .core import Finding, FileCtx, RepoCtx, Rule
+from .tracing import _dotted
+
+# repo-relative path fragments exempt from the wall-clock/rng checks:
+# observability reports wall time by design; loggers/metrics stamp
+# records; examples and scripts are drivers, not decision code
+PERIMETER = (
+    "/observability/", "/lightning/loggers.py", "/utils/metrics.py",
+    "/examples/", "/lightning/callbacks.py",
+)
+
+WALL_CLOCK = {"time.time", "datetime.now", "datetime.datetime.now",
+              "datetime.utcnow", "datetime.datetime.utcnow"}
+# module-level (process-global, unseeded) rng draws; seeded constructors
+# are explicitly blessed
+UNSEEDED_RANDOM_MODS = ("random.", "np.random.", "numpy.random.")
+SEEDED_CTORS = {"Random", "RandomState", "default_rng", "Generator",
+                "SeedSequence", "Philox", "PCG64", "MT19937", "seed"}
+ORDER_FREE = {"len", "min", "max", "sum", "any", "all", "sorted",
+              "frozenset", "set"}
+
+
+def _set_typed_names(fn_or_mod: ast.AST, cls: ast.AST = None) -> Set[str]:
+    """Local names (and ``self.x`` attrs assigned a set in the enclosing
+    class) whose value is statically a set: ``set()`` / set literal /
+    set comprehension / ``frozenset(...)``, or annotated ``: set``."""
+    names: Set[str] = set()
+
+    def is_set_expr(v: ast.AST) -> bool:
+        if isinstance(v, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(v, ast.Call):
+            d = _dotted(v.func)
+            return d in ("set", "frozenset")
+        return False
+
+    scopes = [fn_or_mod] + ([cls] if cls is not None else [])
+    for scope in scopes:
+        # when walking the CLASS (for self-attr sets assigned in other
+        # methods, typically __init__), bare local names belong to those
+        # other methods' scopes — collecting them would taint unrelated
+        # locals that happen to share a name
+        attrs_only = scope is not fn_or_mod
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and is_set_expr(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and not attrs_only:
+                        names.add(t.id)
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self"):
+                        names.add("self." + t.attr)
+            elif isinstance(node, ast.AnnAssign):
+                ann = node.annotation
+                ann_s = ""
+                if isinstance(ann, ast.Name):
+                    ann_s = ann.id
+                elif isinstance(ann, ast.Subscript) and isinstance(
+                        ann.value, ast.Name):
+                    ann_s = ann.value.id
+                if ann_s in ("set", "Set", "frozenset", "FrozenSet"):
+                    t = node.target
+                    if isinstance(t, ast.Name) and not attrs_only:
+                        names.add(t.id)
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self"):
+                        names.add("self." + t.attr)
+    return names
+
+
+def _expr_key(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return "self." + node.attr
+    return ""
+
+
+def _iter_findings_sets(fc: FileCtx) -> Iterator[Finding]:
+    # class-level set attrs (assigned anywhere in the class, typically
+    # __init__) are visible to every method of that class
+    class_of: Dict[int, ast.ClassDef] = {}
+    for cls in ast.walk(fc.tree):
+        if isinstance(cls, ast.ClassDef):
+            for sub in ast.walk(cls):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_of.setdefault(id(sub), cls)
+    for fn in ast.walk(fc.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sets = _set_typed_names(fn, class_of.get(id(fn)))
+        if not sets:
+            continue
+        for node in ast.walk(fn):
+            iters = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            elif (isinstance(node, ast.Call)
+                  and _dotted(node.func) in ("list", "tuple", "iter")
+                  and node.args):
+                iters.append(node.args[0])
+            for it in iters:
+                key = _expr_key(it)
+                if key in sets:
+                    # `sorted(...)` wrapping happens ABOVE the iter expr,
+                    # so a bare Name here is already unsorted
+                    parent = getattr(node, "_nxd_parent", None)
+                    if (isinstance(parent, ast.Call)
+                            and _dotted(parent.func) in ORDER_FREE):
+                        continue
+                    yield Finding(
+                        "determinism", fc.rel, it.lineno,
+                        fc.qualname_at(node),
+                        f"bare-set iteration over '{key}' in decision code "
+                        f"— iteration order is insertion/hash dependent; "
+                        f"wrap in sorted(...)")
+
+
+def check(ctx: RepoCtx) -> Iterator[Finding]:
+    for fc in ctx.files:
+        if "/analysis/" in fc.rel:
+            continue
+        in_perimeter = any(p in "/" + fc.rel for p in PERIMETER)
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not d:
+                continue
+            if d in WALL_CLOCK and not in_perimeter:
+                yield Finding(
+                    "determinism", fc.rel, node.lineno, fc.qualname_at(node),
+                    f"wall-clock read {d}() outside the observability "
+                    f"perimeter — decisions live on the virtual block clock")
+            elif (not in_perimeter
+                  and any(d.startswith(m) for m in UNSEEDED_RANDOM_MODS)
+                  and d.rsplit(".", 1)[-1] not in SEEDED_CTORS):
+                yield Finding(
+                    "determinism", fc.rel, node.lineno, fc.qualname_at(node),
+                    f"unseeded module-level rng draw {d}() — use a seeded "
+                    f"Random/RandomState/default_rng instance")
+        yield from _iter_findings_sets(fc)
+
+
+RULE = Rule(
+    id="determinism",
+    doc="no wall clock, unseeded rng, or bare-set iteration in "
+        "scheduling/placement decision code",
+    check=check,
+)

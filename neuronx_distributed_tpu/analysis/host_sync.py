@@ -1,0 +1,198 @@
+"""Rule ``host-sync``: no host synchronization inside traced code.
+
+The serving stack's dispatch-amortization story (one program dispatch +
+one host fetch per K-token block — the ≤2-host-ops-per-fused-block
+contract, PROFILE.md r5's ~5 ms/token dispatch floor) dies silently the
+moment someone `.item()`s a traced value inside a scan body: jax inserts
+a device→host sync per step and the tracer-span contract tests only
+notice at runtime, on the paths they happen to drive. This rule flags
+the whole class statically, inside every traced scope (jit-boundary
+functions, ``lax.scan``/``fori_loop``/``while_loop`` bodies, and
+anything nested in them):
+
+* unconditional sinks: ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()``, ``jax.device_get``, ``np.asarray`` /
+  ``np.array`` (host materialization), ``print``;
+* tainted sinks — only when fed a value derived from the traced
+  function's parameters: ``float()`` / ``int()`` / ``bool()`` coercion
+  (a ConcretizationError or, worse, a silent sync under weak typing) and
+  Python-side control flow (``if`` / ``while`` / ``for`` over a traced
+  value — trace-time branching on closure config like ``if greedy:``
+  stays legal because closure names are never seeded).
+
+``static_argnums`` parameters are concrete at trace time and excluded
+from the seeds; ``.shape`` / ``.dtype`` / ``.ndim`` projections are
+static under jit and sanitize the taint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from .core import Finding, FileCtx, RepoCtx, Rule
+from .tracing import FuncNode, ScopeNode, _dotted, traced_functions
+
+# attribute calls that force a device->host sync wherever they appear
+SYNC_ATTRS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+# dotted callables that materialize on host
+SYNC_CALLS = {
+    "jax.device_get", "np.asarray", "np.array", "numpy.asarray",
+    "numpy.array", "onp.asarray", "onp.array",
+}
+COERCIONS = {"float", "int", "bool", "complex"}
+# projections that are static under trace — reading them is not a sync
+# and does not propagate taint
+SAFE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    # vararg/kwarg names deliberately excluded: `if tail:` tests the
+    # TUPLE's emptiness, which is static at trace time (the grammar-quad
+    # `*gr` idiom) — elements unpacked from it lose taint, an accepted
+    # false-negative
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+class _Taint:
+    """Flow-insensitive name taint inside one traced scope: seeds are the
+    traced parameters (of the scope and of any nested def — nested scan
+    bodies carry traced state too); assignment propagates. Deliberately
+    simple — false negatives on closure arrays are acceptable, false
+    positives on config branching are not."""
+
+    def __init__(self, fn: ast.AST, static: Set[str], scan_ids: Set[int]):
+        self.names: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ScopeNode):
+                continue
+            # seed the root's params and nested SCAN BODIES' params
+            # (their carry is traced state); other nested defs are
+            # helpers / tree_map callbacks whose params (paths, leaves)
+            # are structural — seeding them flags trace-time structure
+            # branching, which is legal
+            if node is fn or id(node) in scan_ids:
+                self.names |= (_param_names(node)
+                               - (static if node is fn else set()))
+        # propagate through assignments until fixpoint (bounded: each
+        # pass only ever adds names)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                tgts = None
+                if isinstance(node, ast.Assign):
+                    tgts, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    tgts, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    tgts, value = [node.target], node.value
+                else:
+                    continue
+                if not self.expr(value):
+                    continue
+                for t in tgts:
+                    for leaf in ast.walk(t):
+                        if (isinstance(leaf, ast.Name)
+                                and leaf.id not in self.names):
+                            self.names.add(leaf.id)
+                            changed = True
+
+    def expr(self, node: ast.AST) -> bool:
+        """Does the expression read a tainted name outside a static
+        projection (``x.shape[0]`` is clean, ``x[0]`` is not)?"""
+        if isinstance(node, ast.Attribute) and node.attr in SAFE_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            # len(x) / x.shape projections are static; the call's OTHER
+            # arguments may still carry taint
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        return any(self.expr(c) for c in ast.iter_child_nodes(node))
+
+
+def _check_file(fc: FileCtx) -> Iterator[Finding]:
+    traced = traced_functions(fc.tree)
+    if not traced:
+        return
+    # avoid double-reporting: a scan body nested inside a jitted fn is
+    # walked once, from the outermost traced scope
+    roots = []
+    covered = set()
+    for info in traced.values():
+        node = info["node"]
+        enclosing_ids = set()
+        for other in traced.values():
+            if other["node"] is node:
+                continue
+            for sub in ast.walk(other["node"]):
+                if sub is node:
+                    enclosing_ids.add(id(other["node"]))
+        if not enclosing_ids:
+            roots.append(info)
+    scan_ids = {id(i["node"]) for i in traced.values() if i["kind"] == "scan"}
+    for info in roots:
+        fn = info["node"]
+        if id(fn) in covered:
+            continue
+        covered.add(id(fn))
+        taint = _Taint(fn, info["static"], scan_ids)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in SYNC_ATTRS):
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        f".{node.func.attr}() inside traced code forces a "
+                        f"device->host sync per step")
+                elif dotted in SYNC_CALLS:
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        f"{dotted}() inside traced code materializes on host")
+                elif dotted == "print":
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        "print() inside traced code (use jax.debug.print)")
+                elif (dotted in COERCIONS and node.args
+                      and not isinstance(node.args[0], ast.Constant)
+                      and taint.expr(node.args[0])):
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        f"{dotted}() coercion of a traced value "
+                        f"(concretizes under trace)")
+            elif isinstance(node, (ast.If, ast.While)):
+                if taint.expr(node.test):
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        "Python-side branch on a traced value (use "
+                        "jnp.where / lax.cond)")
+            elif isinstance(node, ast.For):
+                if taint.expr(node.iter):
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        "Python-side iteration over a traced value")
+            elif isinstance(node, ast.Assert):
+                if taint.expr(node.test):
+                    yield Finding(
+                        "host-sync", fc.rel, node.lineno, fc.qualname_at(node),
+                        "assert on a traced value concretizes under trace")
+
+
+def check(ctx: RepoCtx) -> Iterator[Finding]:
+    for fc in ctx.files:
+        if "/analysis/" in fc.rel:
+            continue
+        yield from _check_file(fc)
+
+
+RULE = Rule(
+    id="host-sync",
+    doc="no host syncs / Python branching on traced values inside "
+        "jit-lowered programs and scan bodies",
+    check=check,
+    zero_waiver=True,
+)

@@ -1,0 +1,129 @@
+"""Rule ``cache-replication``: every cache-returning program routes its
+cache through ``_replicate_out`` at the program boundary.
+
+The PR 3 bug class: session caches round-trip between separately
+compiled programs whose inputs are lowered replicated. A program that
+returns a cache WITHOUT the ``_replicate_out`` pin lets GSPMD pick a
+sharded output layout (observed: batch over 'edp' whenever max_batch
+divides it — trace-shape dependent, so it bit only some schedules), and
+the next AOT call rejects it. The fix pinned every boundary; this rule
+keeps it pinned as new programs are added.
+
+Scope: functions passed to ``jax.jit`` (call, decorator, or lambda
+form) — the PROGRAM boundaries. Scan bodies are exempt: their returns
+stay inside the program. A returned tuple element "carries a cache" when
+it mentions a cache-ish identifier (``cache`` / ``t_cache`` /
+``mut["cache"]`` / ``adapters`` / ``grammars``); such an element must
+have every cache-ish mention inside a ``*._replicate_out(...)`` call or
+a local alias of it (``constrain = self._replicate_out``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Set
+
+from .core import Finding, FileCtx, RepoCtx, Rule
+from .tracing import replicator_aliases, traced_functions
+
+CACHEISH_NAME = re.compile(r"(^|_)(cache|caches|t_cache|d_cache)$"
+                           r"|^(adapters|grammars)$")
+CACHEISH_KEY = re.compile(r"^cache$|^adapters$|^grammars$")
+
+
+def _cache_mentions(node: ast.AST) -> Iterator[ast.AST]:
+    if isinstance(node, ast.Name) and CACHEISH_NAME.search(node.id):
+        yield node
+    elif (isinstance(node, ast.Subscript)
+          and isinstance(node.slice, ast.Constant)
+          and isinstance(node.slice.value, str)
+          and CACHEISH_KEY.match(node.slice.value)):
+        yield node
+    else:
+        for child in ast.iter_child_nodes(node):
+            yield from _cache_mentions(child)
+
+
+def _is_replicator(call: ast.Call, aliases: Set[str]) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in ("_replicate_out",
+                                                   "replicate_out"):
+        return True
+    return isinstance(f, ast.Name) and (
+        f.id in aliases or f.id in ("_replicate_out", "replicate_out"))
+
+
+def _uncovered(elem: ast.AST, aliases: Set[str]) -> bool:
+    """True when the element mentions a cache outside any replicator
+    call. Walked top-down: entering a replicator call clears everything
+    below it."""
+    if isinstance(elem, ast.Call) and _is_replicator(elem, aliases):
+        return False
+    if isinstance(elem, ast.Name) and CACHEISH_NAME.search(elem.id):
+        return True
+    if (isinstance(elem, ast.Subscript)
+            and isinstance(elem.slice, ast.Constant)
+            and isinstance(elem.slice.value, str)
+            and CACHEISH_KEY.match(elem.slice.value)):
+        return True
+    return any(_uncovered(c, aliases) for c in ast.iter_child_nodes(elem))
+
+
+def _returned_elements(fn: ast.AST) -> Iterator[ast.AST]:
+    if isinstance(fn, ast.Lambda):
+        body = fn.body
+        elems = body.elts if isinstance(body, ast.Tuple) else [body]
+        for e in elems:
+            yield e
+        return
+    for node in ast.walk(fn):
+        # returns of defs nested inside the boundary fn are NOT program
+        # outputs — skip any return not belonging to fn itself
+        if isinstance(node, ast.Return) and node.value is not None:
+            owner = node
+            while owner is not None and not isinstance(
+                    owner, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+                owner = getattr(owner, "_nxd_parent", None)
+            if owner is not fn:
+                continue
+            v = node.value
+            elems = v.elts if isinstance(v, ast.Tuple) else [v]
+            for e in elems:
+                yield e
+
+
+def _check_file(fc: FileCtx) -> Iterator[Finding]:
+    traced = traced_functions(fc.tree)
+    if not traced:
+        return
+    aliases = replicator_aliases(fc.tree)
+    for info in traced.values():
+        if info["kind"] != "jit":
+            continue
+        fn = info["node"]
+        for elem in _returned_elements(fn):
+            if _uncovered(elem, aliases):
+                yield Finding(
+                    "cache-replication", fc.rel, elem.lineno,
+                    fc.qualname_at(elem),
+                    "program boundary returns a cache collection without "
+                    "_replicate_out — GSPMD may hand back a sharded cache "
+                    "the next AOT call rejects (PR 3 class)")
+
+
+def check(ctx: RepoCtx) -> Iterator[Finding]:
+    for fc in ctx.files:
+        if "/analysis/" in fc.rel:
+            continue
+        yield from _check_file(fc)
+
+
+RULE = Rule(
+    id="cache-replication",
+    doc="cache-returning jit programs must pin outputs replicated via "
+        "_replicate_out at the program boundary",
+    check=check,
+    zero_waiver=True,
+)

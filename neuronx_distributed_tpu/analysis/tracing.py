@@ -1,0 +1,177 @@
+"""Shared traced-scope discovery for the host-sync and replication rules.
+
+"Traced" here means: the function object is handed to XLA — passed to
+``jax.jit`` (call form, ``@jax.jit`` / ``@partial(jax.jit, ...)``
+decorator form, or a lambda argument), or used as a ``lax.scan`` body.
+Everything lexically inside such a function runs under trace, including
+nested ``def``\\ s, so sinks are searched through the whole subtree.
+
+Static arguments (``static_argnums``) are concrete at trace time —
+branching on them is legitimate — so they are excluded from the taint
+seeds the host-sync rule starts from.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeNode = FuncNode + (ast.Lambda,)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit(func: ast.AST) -> bool:
+    return _dotted(func) in ("jax.jit", "jit")
+
+
+def _is_scan(func: ast.AST) -> bool:
+    return _dotted(func) in ("jax.lax.scan", "lax.scan",
+                             "jax.lax.fori_loop", "lax.fori_loop",
+                             "jax.lax.while_loop", "lax.while_loop")
+
+
+def _static_argnums(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                return ()
+            if isinstance(v, int):
+                return (v,)
+            try:
+                return tuple(int(x) for x in v)
+            except TypeError:
+                return ()
+    return ()
+
+
+class _Scopes:
+    """Lexical def/lambda table so ``jax.jit(name)`` resolves to the
+    FunctionDef it names, walking outward from the reference site."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[int, Dict[str, ast.AST]] = {}
+        self.parent: Dict[int, Optional[ast.AST]] = {}
+
+        def visit(node: ast.AST, scope: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, FuncNode):
+                    self.defs.setdefault(id(scope), {})[child.name] = child
+                    self.parent[id(child)] = scope
+                    visit(child, child)
+                elif isinstance(child, ast.Lambda):
+                    self.parent[id(child)] = scope
+                    visit(child, child)
+                else:
+                    visit(child, scope)
+
+        self.parent[id(tree)] = None
+        visit(tree, tree)
+        # enclosing scope of every node (for name resolution at call sites)
+        self.enclosing: Dict[int, ast.AST] = {}
+
+        def mark(node: ast.AST, scope: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                s = child if isinstance(child, ScopeNode) else scope
+                self.enclosing[id(child)] = scope
+                mark(child, s)
+
+        mark(tree, tree)
+
+    def resolve(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        scope: Optional[ast.AST] = self.enclosing.get(id(at))
+        while scope is not None:
+            fn = self.defs.get(id(scope), {}).get(name)
+            if fn is not None:
+                return fn
+            scope = self.parent.get(id(scope))
+        return None
+
+
+def traced_functions(tree: ast.AST) -> Dict[int, dict]:
+    """id(func-node) -> {"node", "kind" ("jit"|"scan"), "static": set of
+    param names excluded from taint}. Kind "jit" marks a PROGRAM BOUNDARY
+    (the replication rule applies); "scan" marks a loop body (host-sync
+    only — its returns stay inside the program)."""
+    scopes = _Scopes(tree)
+    out: Dict[int, dict] = {}
+
+    def param_names(fn: ast.AST) -> List[str]:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        return names
+
+    def add(fn: ast.AST, kind: str, static_idx: Tuple[int, ...]) -> None:
+        names = param_names(fn)
+        static = {names[i] for i in static_idx if i < len(names)}
+        prev = out.get(id(fn))
+        if prev is not None:
+            # jit wins over scan for boundary purposes
+            if prev["kind"] == "jit" or kind != "jit":
+                prev["static"] |= static
+                return
+        out[id(fn)] = {"node": fn, "kind": kind, "static": static}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _is_jit(node.func) and node.args:
+                target = node.args[0]
+                sid = _static_argnums(node)
+                if isinstance(target, ast.Name):
+                    fn = scopes.resolve(target.id, node)
+                    if fn is not None:
+                        add(fn, "jit", sid)
+                elif isinstance(target, ast.Lambda):
+                    add(target, "jit", sid)
+            elif _is_scan(node.func) and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    fn = scopes.resolve(target.id, node)
+                    if fn is not None:
+                        add(fn, "scan", ())
+                elif isinstance(target, ast.Lambda):
+                    add(target, "scan", ())
+        if isinstance(node, FuncNode):
+            for dec in node.decorator_list:
+                if _is_jit(dec):
+                    add(node, "jit", ())
+                elif (isinstance(dec, ast.Call) and _is_jit(dec.func)):
+                    add(node, "jit", _static_argnums(dec))
+                elif (isinstance(dec, ast.Call)
+                      and _dotted(dec.func) in ("partial", "functools.partial")
+                      and dec.args and _is_jit(dec.args[0])):
+                    add(node, "jit", _static_argnums(dec))
+    return out
+
+
+def walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node lexically inside ``fn`` (nested defs included — they
+    run under the same trace)."""
+    yield from ast.walk(fn)
+
+
+def replicator_aliases(tree: ast.AST) -> Set[str]:
+    """Names bound to a ``_replicate_out`` bound method (the
+    ``constrain = self._replicate_out`` idiom)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "_replicate_out"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out

@@ -157,6 +157,7 @@ def _retry(fn: Callable, attempts: Optional[int] = None,
         except Exception as e:  # noqa: BLE001 — storage errors are driver-specific
             if i == attempts - 1:
                 raise
+            # nxdcheck: waive determinism -- retry backoff jitter is wall-timing only (desynchronizes storage retries across hosts); it never feeds a scheduling/placement decision or a replayed stream
             delay = base_delay * (2 ** i) * (1.0 + jitter * random.random())
             logger.warning("storage op failed (%s); retry %d/%d in %.2fs",
                            e, i + 1, attempts, delay)

@@ -32,6 +32,27 @@ from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 PyTree = Any
 
 
+def replicate_out(tree: PyTree) -> PyTree:
+    """Program-boundary sharding pin: force every leaf fully replicated
+    when a device mesh is active (no-op otherwise). Every compiled
+    program that RETURNS a session cache / adapter / grammar collection
+    must route it through this constraint — the AOT session programs are
+    lowered on replicated cache avals, and an unconstrained output lets
+    GSPMD hand back a sharded layout the next call rejects (the PR 3
+    class; statically enforced by nxdcheck's cache-replication rule).
+    Module-level so standalone program builders (``inference/medusa.py``)
+    share the exact constraint ``CausalLM`` uses."""
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    if not ps.model_parallel_is_initialized():
+        return tree
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, repl), tree)
+
+
 def _set_block_tables(cache: PyTree, tables) -> PyTree:
     """Overwrite every per-layer block_table leaf (stacked (L, b, ppseq))
     with the host allocator's current tables — the ONLY cache leaves the
@@ -855,7 +876,12 @@ class CausalLM:
                     logits, mut = self.model.apply(
                         self._ad_vars(params, None, ad), ids,
                         mutable=["cache"])
-                    return logits, mut["cache"]
+                    # boundary pin like every cache-returning program:
+                    # the scatter's own constraint used to be the only
+                    # cover here, but these fresh rows ARE cache avals
+                    # crossing a program boundary (no-op off-mesh, and
+                    # the reshard is O(rows) either way)
+                    return logits, self._replicate_out(mut["cache"])
 
                 ids0 = jnp.zeros((rows, bucket), jnp.int32)
                 self._insert_prefill[pkey] = self._time_compile(
@@ -883,15 +909,7 @@ class CausalLM:
         device mesh is active (no-op otherwise) — session-cache-producing
         programs must hand back the replicated layout the AOT session
         programs were lowered with."""
-        from neuronx_distributed_tpu.parallel import mesh as ps
-
-        if not ps.model_parallel_is_initialized():
-            return tree
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        repl = NamedSharding(ps.get_mesh(), PartitionSpec())
-        return jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, repl), tree)
+        return replicate_out(tree)
 
     def _paged_insert_programs(self, rows: int, bucket: int):
         """Lazily compile the paged insert for ``rows`` prompts at suffix

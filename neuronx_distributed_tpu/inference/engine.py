@@ -2267,7 +2267,14 @@ class ServeEngine:
             self._active[slot] = False
             self._done[slot] = False
             # the pin moves with the stream: released here, re-taken by the
-            # adopting decode worker (the drain-migration discipline)
+            # adopting decode worker (the drain-migration discipline).
+            # Adapter pins CANNOT exist on this seam — disagg submit
+            # rejects adapter-labeled requests (adopted KV is
+            # adapter-specific); the assert is the static witness
+            # nxdcheck's resource-pairing rule checks, and it fires in
+            # tests if that restriction is ever relaxed without teaching
+            # the handoff to migrate the pin
+            assert req.request_id not in self._adapter_pins
             self._release_grammar(req)
             self._gidx[slot] = 0
             self._out.pop(rid, None)

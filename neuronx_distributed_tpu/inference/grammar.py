@@ -287,7 +287,10 @@ class _Parser:
                 if y not in seen:
                     seen.add(y)
                     stack.append(y)
-        remap = {x: self.nfa.state() for x in seen}
+        # sorted(): fresh state ids must not depend on set-iteration
+        # order — the compiled table bytes (and their on-device digests)
+        # have to be identical across processes for snapshot/replay
+        remap = {x: self.nfa.state() for x in sorted(seen)}
         for a, pr, b in list(self.nfa.edges):
             if a in remap and b in remap:
                 self.nfa.edges.append((remap[a], pr, remap[b]))

@@ -887,7 +887,11 @@ class Router:
                 or self._rload[i].queue_depth < self.replica_queue_depth)}
         if not self._open:
             return
-        self._open_heap = [(self._score0(i), i) for i in self._open]
+        # sorted(): heap pops are key-ordered regardless of build order,
+        # but the heap ARRAY layout (and any tie-broken peek a future
+        # change adds) would inherit set-iteration order — keep the build
+        # deterministic (nxdcheck determinism rule)
+        self._open_heap = [(self._score0(i), i) for i in sorted(self._open)]
         heapq.heapify(self._open_heap)
         for e in self.pending.iter_ready(self.blocks):
             if not self._open:

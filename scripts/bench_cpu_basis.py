@@ -32,6 +32,14 @@ baseline instead of paying the full tiny-dims compile sweep:
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
         --sched-update BENCH_r06.json BENCH_r07.json
+
+Structured-decoding refresh (ISSUE 15): the three structured HEADLINE
+keys predate no committed serving artifact (r06 predates PR 13; r07 only
+merged sched keys), so they never gated. ``--structured-update`` builds
+one tiny-dims model and re-measures just ``bench.bench_structured``:
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --structured-update BENCH_r07.json BENCH_r08.json
 """
 
 from __future__ import annotations
@@ -79,9 +87,75 @@ def _sched_update(base_path: str, out_path: str) -> int:
     return 0
 
 
+def _structured_update(base_path: str, out_path: str) -> int:
+    """BENCH_r0(x+1) = BENCH_r0x + freshly measured structured-decoding
+    keys (ISSUE 15 bench-surface audit: r06 predates PR 13 and r07 only
+    merged sched keys, so the three structured HEADLINE keys were absent
+    from every committed serving artifact — bench_regress reported them
+    as new_key forever and they never gated). Builds ONE tiny-dims model
+    and runs just bench.bench_structured over it — the same CPU basis as
+    the carried-over sections, at a fraction of the full sweep."""
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models.llama import (LlamaConfig,
+                                                      LlamaForCausalLM)
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, neuronx_distributed_config,
+    )
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+
+    prompt_len, max_batch = 128, 4
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    cfg = neuronx_distributed_config(tensor_parallel_size=1)
+    lcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=prompt_len + 256, dtype=jnp.float32,
+        param_dtype=jnp.float32, use_flash_attention=False,
+        remat_policy=None)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg),
+                                      ids)
+    structured = bench.bench_structured(lcfg, model.params,
+                                        prompt_len=prompt_len,
+                                        max_batch=max_batch, fused_steps=16)
+    parsed.update(structured)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | structured keys measured by --structured-update on top of "
+        + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--structured-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in structured if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) >= 4 and sys.argv[1] == "--sched-update":
         return _sched_update(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 4 and sys.argv[1] == "--structured-update":
+        return _structured_update(sys.argv[2], sys.argv[3])
 
     import jax.numpy as jnp
 

@@ -100,6 +100,12 @@ RULES: List[Tuple[str, str, float]] = [
     (r".*(miss_rate|shed_rate|error_rate).*", "lower", 0.20),
     # more is better
     (r"value|vs_baseline", "higher", 0.05),
+    # conservative-fit train ratio (ISSUE 15 surface audit: was silently
+    # ungated — matched nothing and reported "info") and the
+    # serving-engine honesty ratio (fused pool vs solo generate on the
+    # same programs; higher is better, it approaching 1.0 is the claim)
+    (r"train_vs_baseline_conservative", "higher", 0.05),
+    (r"serve_fused_vs_generate_fused16", "higher", 0.10),
     (r"(mfu_.*|.*tokens_per_sec.*|.*goodput.*|.*speedup.*|.*acceptance.*"
      r"|.*throughput.*)", "higher", 0.10),
     # wall/device timings: lower is better, device windows are noisy

@@ -31,6 +31,11 @@ jax.config.update("jax_disable_most_optimizations", True)
 
 import pytest  # noqa: E402
 
+# the nxdcheck fixture corpus contains mini-repos with their own
+# `tests/test_*.py` files (surface-drift rule inputs, read by ast only —
+# tests/test_static_analysis.py) — pytest must not collect them
+collect_ignore = ["fixtures"]
+
 
 @pytest.fixture(autouse=True)
 def _reset_parallel_state():

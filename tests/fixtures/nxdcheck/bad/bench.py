@@ -1,0 +1,10 @@
+"""Known-bad fixture bench surface: ``ghost_ratio`` matches no regress
+rule (silently ungated) and ``serve_thing_ms`` is declared but absent
+from the committed artifact."""
+
+HEADLINE_KEYS = (
+    "ghost_ratio",
+    "serve_thing_ms",
+    "serve_present_ms",
+    "bench_error",
+)

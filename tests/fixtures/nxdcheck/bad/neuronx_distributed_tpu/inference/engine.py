@@ -1,0 +1,32 @@
+"""Known-bad fixture for resource-pairing: a seam that forgets one
+release-family member, a page hold left exposed to a dispatch failure,
+and a pool pin acquired without being recorded."""
+
+
+class ServeEngine:
+    def _release_adapter(self, req):
+        self.session.adapters.release(self._adapter_pins.pop(
+            req.request_id, None))
+
+    def _release_grammar(self, req):
+        self.session.grammars.release(self._grammar_pins.pop(
+            req.request_id, None))
+
+    def cancel(self, request_id):
+        req = self._by_id[request_id]
+        self._out.pop(request_id, None)      # drops request ownership...
+        self._release_adapter(req)           # ...but forgets the grammar pin
+
+    def _admit(self, req):
+        plan = self.session.paged.plan(req.tokens, 8)
+        # dispatch while the hold is live and UNPROTECTED: a failed
+        # dispatch leaks one admission's footprint (the PR 5 storm class)
+        logits = self._dispatch("insert", lambda: self.lm.insert(req))
+        self.session.paged.commit(0, plan, req.tokens)
+        return logits
+
+    def _adopt(self, req):
+        # pin acquired outside _acquire_* and never recorded in a *_pins
+        # map: no seam can ever release it
+        self.session.grammars.acquire(req.grammar)
+        return self.session.grammars.slot_of(req.grammar)

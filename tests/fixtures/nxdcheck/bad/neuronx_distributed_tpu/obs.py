@@ -1,0 +1,12 @@
+"""Fixture producer (bad root): emits ONE stats key and ONE event so the
+observability-names check has a producer pool — the ghost names the
+fixture test asserts on are still unproduced."""
+
+_STAT_KEYS = ("real_key",)
+
+
+class Engine:
+    def step(self):
+        self.stats["real_key"] += 1
+        self.tracer.instant("real_event", ("eng", "x"))
+        self.tracer.instant(f"fault:{self.kind}", ("eng", "fault"))

@@ -1,0 +1,24 @@
+"""Known-bad fixture for determinism: wall-clock reads, unseeded rng,
+and bare-set iteration inside scheduling decision code."""
+
+import random
+import time
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._open = set()
+        self._tenants: set = set()
+
+    def pick(self, candidates):
+        now = time.time()                      # wall clock in a decision
+        jitter = random.random()               # unseeded module-level rng
+        noise = np.random.uniform()            # unseeded np global stream
+        deferred = set(candidates)
+        for i in deferred:                     # bare-set iteration (local)
+            return i, now, jitter, noise
+        for t in self._tenants:                # bare-set iteration (attr)
+            return t
+        return [x for x in self._open]         # comprehension over a set

@@ -1,0 +1,43 @@
+"""Known-bad fixture: host syncs inside traced code + a cache-returning
+program boundary with no ``_replicate_out`` pin. Every construct here
+must keep firing its rule (tests/test_static_analysis.py pins it)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_bad_scan(model):
+    def body(carry, _):
+        cache, tok = carry
+        if tok > 0:                    # Python branch on a traced value
+            tok = tok + 1
+        v = float(tok)                 # coercion concretizes the tracer
+        host = np.asarray(tok)         # host materialization in-trace
+        s = tok.item()                 # device->host sync per step
+        print(tok)                     # host side effect in-trace
+        del v, host, s
+        return (cache, tok), tok
+
+    def fn(params, cache, tok):
+        (cache, tok), toks = jax.lax.scan(body, (cache, tok), None, length=4)
+        return toks, cache             # cache out, no _replicate_out pin
+
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def build_bad_decode(model):
+    def decode_fn(params, cache, ids):
+        logits, mut = model.apply({"params": params, "cache": cache}, ids,
+                                  mutable=["cache"])
+        return logits, mut["cache"]    # unpinned program boundary
+    return jax.jit(decode_fn, donate_argnums=(1,))
+
+
+def build_bad_loop(model):
+    def fn(params, xs):
+        total = jnp.zeros(())
+        for x in xs:                   # Python iteration over traced value
+            total = total + x
+        return total
+    return jax.jit(fn)

@@ -1,0 +1,9 @@
+"""Known-good fixture bench surface: every gating key has a regress
+rule and appears in the committed artifact."""
+
+HEADLINE_KEYS = (
+    "serve_thing_ms",
+    "serve_present_ms",
+    "good_ratio",
+    "bench_error",
+)

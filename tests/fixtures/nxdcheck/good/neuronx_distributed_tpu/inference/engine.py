@@ -1,0 +1,56 @@
+"""Known-good fixture for resource-pairing: the same seams written with
+the repo's discipline — full-family releases (directly or by
+delegation), assert-absence witnesses, try/except rollback around
+dispatches, pins recorded at acquire."""
+
+
+class ServeEngine:
+    def _release_adapter(self, req):
+        self.session.adapters.release(self._adapter_pins.pop(
+            req.request_id, None))
+
+    def _release_grammar(self, req):
+        self.session.grammars.release(self._grammar_pins.pop(
+            req.request_id, None))
+
+    def cancel(self, request_id):
+        req = self._by_id[request_id]
+        self._out.pop(request_id, None)
+        self._release_adapter(req)
+        self._release_grammar(req)
+
+    def _expire(self, req):
+        # delegation counts: the seam reaches the family transitively
+        self._out.pop(req.request_id, None)
+        self._drop_pins(req)
+
+    def _drop_pins(self, req):
+        self._release_adapter(req)
+        self._release_grammar(req)
+
+    def _handoff(self, req):
+        # a seam may PROVE a pin cannot exist instead of releasing it
+        self._out.pop(req.request_id, None)
+        assert req.request_id not in self._adapter_pins
+        self._release_grammar(req)
+
+    def _admit(self, req):
+        plan = self.session.paged.plan(req.tokens, 8)
+        try:
+            logits = self._dispatch("insert", lambda: self.lm.insert(req))
+        except Exception:
+            self.session.paged.rollback(plan)
+            raise
+        self.session.paged.commit(0, plan, req.tokens)
+        return logits
+
+    def _admit_chunked(self, req, slot):
+        # ownership transfer into engine state kills the local hold
+        chunk = self.session.paged.begin_chunked(req.tokens, 8)
+        self._prefilling[slot] = chunk
+        return chunk.start
+
+    def _adopt(self, req):
+        self.session.grammars.acquire(req.grammar)
+        self._grammar_pins[req.request_id] = req.grammar
+        return self.session.grammars.slot_of(req.grammar)

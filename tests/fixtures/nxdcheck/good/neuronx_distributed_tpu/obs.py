@@ -1,0 +1,11 @@
+"""Fixture producer (good root): every name the fixture test consumes
+is emitted here (literal, or by f-string prefix)."""
+
+_STAT_KEYS = ("real_key",)
+
+
+class Engine:
+    def step(self):
+        self.stats["real_key"] += 1
+        self.tracer.instant("real_event", ("eng", "x"))
+        self.tracer.instant(f"fault:{self.kind}", ("eng", "fault"))

@@ -1,0 +1,25 @@
+"""Known-good fixture for determinism: the blessed counterparts — block
+clock / perf_counter for measurement, seeded rng streams, ordered or
+order-free set use."""
+
+import time
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self, seed=0):
+        self._open = set()
+        self._tenants: set = set()
+        self._rs = np.random.RandomState(seed)  # seeded stream: legal
+
+    def pick(self, candidates, blocks):
+        wall_ms = time.perf_counter()           # measurement, not decision
+        draw = self._rs.random_sample()
+        deferred = set(candidates)
+        if 3 in deferred:                       # membership: order-free
+            return 3
+        n = len(self._tenants)                  # reduction: order-free
+        for t in sorted(self._tenants):         # sorted iteration: legal
+            return t, wall_ms, draw, n, blocks
+        return sorted(self._open)

@@ -1,0 +1,54 @@
+"""Known-good fixture: the same program shapes as the bad twin, written
+the way the serving stack writes them — trace-time config branching,
+static projections, vararg emptiness tests, and ``_replicate_out`` at
+every cache boundary. Must stay CLEAN under host-sync and
+cache-replication (the rules' false-positive pin)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def replicate_out(tree):
+    return tree
+
+
+def build_good_scan(model, greedy, eos_token_id):
+    def body(carry, _):
+        cache, tok, rng = carry
+        rng, sub = jax.random.split(rng)
+        logits, mut = model.apply({"params": None, "cache": cache}, tok,
+                                  mutable=["cache"])
+        if greedy:                         # closure config: legal
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1)
+        else:
+            nxt = jax.random.categorical(sub, logits[:, 0, :])
+        if eos_token_id is not None:       # closure config: legal
+            nxt = jnp.where(nxt == eos_token_id, nxt, nxt)
+        b = tok.shape[0]                   # static projection: legal
+        del b
+        return (mut["cache"], nxt[:, None], rng), nxt
+
+    def fn(params, cache, tok, rng, *tail):
+        if tail:                           # vararg emptiness: static
+            (extra,) = tail
+            del extra
+        carry, toks = jax.lax.scan(body, (cache, tok, rng), None, length=4)
+        return toks, replicate_out(carry[0])
+
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def build_good_decode(model, lm):
+    def decode_fn(params, cache, ids):
+        logits, mut = model.apply({"params": params, "cache": cache}, ids,
+                                  mutable=["cache"])
+        return logits, lm._replicate_out(mut["cache"])
+    return jax.jit(decode_fn, donate_argnums=(1,))
+
+
+def build_good_alias(lm):
+    # the `constrain = <lm>._replicate_out` idiom from _insert_programs:
+    # the alias must satisfy the replication rule
+    constrain = lm._replicate_out
+    return jax.jit(
+        lambda cache, fresh: constrain(cache), donate_argnums=(0,))

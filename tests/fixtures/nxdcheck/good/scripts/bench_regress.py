@@ -1,0 +1,7 @@
+"""Fixture regress rule table (good root): full coverage of the fixture
+bench's numeric headline keys."""
+
+RULES = [
+    (r"good_ratio", "higher", 0.10),
+    (r".*_ms", "lower", 0.15),
+]

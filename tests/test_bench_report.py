@@ -612,6 +612,42 @@ def test_bench_regress_committed_r07_gates_sched_keys(tmp_path):
         [r["key"] for r in summary["regressions"]]
 
 
+def test_bench_regress_committed_r08_gates_structured_keys(tmp_path):
+    """ISSUE 15 satellite: BENCH_r08 (scripts/bench_cpu_basis.py
+    --structured-update over r07) closes the bench-surface drift
+    nxdcheck's surface-drift rule flagged — the three structured
+    HEADLINE keys were absent from every committed serving artifact (r06
+    predates PR 13; r07 only merged sched keys), so they compared as
+    new_key forever and never gated. r08 carries them: self-pass,
+    r07 -> r08 lands them as new_key, and an injected parse-rate drop
+    exits 1 (zero tolerance — a parse-rate move is a masking bug, not
+    noise)."""
+    doc = json.loads((REPO / "BENCH_r08.json").read_text())
+    assert doc["rc"] == 0 and "--structured-update" in doc["cmd"]
+    p = doc["parsed"]
+    for key in ("serve_structured_parse_rate",
+                "serve_itl_p50_ms_structured_vs_freeform",
+                "grammar_compile_ms"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    # the structural guarantees, pinned on the committed artifact
+    assert p["serve_structured_parse_rate"] == 1.0
+    assert p["serve_itl_p50_ms_structured_vs_freeform"] >= 0.9
+    rc, summary, err = _regress(REPO / "BENCH_r08.json",
+                                REPO / "BENCH_r08.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    rc, summary, _ = _regress(REPO / "BENCH_r07.json",
+                              REPO / "BENCH_r08.json")
+    assert rc == 0, "structured keys must land as new_key over r07"
+    bad = dict(doc, parsed=dict(p, serve_structured_parse_rate=0.96))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r08.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert "serve_structured_parse_rate" in \
+        [r["key"] for r in summary["regressions"]]
+
+
 def test_bench_regress_autoscale_direction_rules(tmp_path):
     """Direction-of-goodness for the autoscale keys: a FALLING
     goodput-per-capacity ratio or a RISING time-to-ready regresses; the

@@ -1865,6 +1865,14 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
                                 max_batch=max_batch,
                                 fused_steps=fused_steps))
 
+    # --- TP-sharded serving (ISSUE 16 tentpole evidence): factored out as
+    # bench_serving_tp() so scripts/bench_cpu_basis.py --tp-update can
+    # refresh just these keys. NOTE: rebuilds its own params per TP world
+    # (mesh state is torn down and re-initialized inside the section).
+    out.update(bench_serving_tp(lcfg, prompt_len=prompt_len,
+                                max_batch=max_batch,
+                                fused_steps=fused_steps))
+
 
     # --- fleet-scale scheduler soak (ROADMAP #18, ISSUE 14 tentpole):
     # 100 sim replicas x 1k/100k/1M virtual-clock requests through the
@@ -2013,6 +2021,109 @@ def bench_structured(lcfg, params, prompt_len=128, max_batch=4,
     return out
 
 
+def bench_serving_tp(lcfg, prompt_len=128, max_batch=4,
+                     fused_steps=16, tp=2) -> dict:
+    """TP-sharded serving section (ISSUE 16 tentpole evidence), a
+    standalone function like :func:`bench_structured` so the CPU-basis
+    baseline driver (``scripts/bench_cpu_basis.py --tp-update``) can
+    refresh JUST these keys over a committed artifact. Three claims:
+
+    * ``serve_tokens_per_sec_tp2`` vs ``serve_tokens_per_sec_tp1`` (and
+      their ratio ``serve_tp2_vs_tp1``) — the same paged continuous-
+      batching trace on a TP=2 mesh vs the TP=1 baseline. On the CPU
+      mesh this measures overhead parity (the per-shard programs plus
+      emulated collectives must not stall the pool); on real hardware
+      the sharded pool is also the latency win;
+    * ``serve_kv_pool_capacity_x_tp`` — per-chip KV pool bytes at TP=1
+      divided by per-chip bytes at TP=tp: the capacity-multiplication
+      claim (~×tp — logical pages per chip-equivalent multiply, since
+      each chip holds only its head-shard of every page);
+    * the exactness oracle rides along: both runs' token streams must be
+      bit-identical (``serve_tp2_stream_equal``, sidecar) — a divergence
+      fails the section.
+
+    Builds its own params per TP world via the trainer's deterministic
+    seed-0 init (value-identical across degrees), so any ``lcfg`` whose
+    kv-head/vocab counts divide ``tp`` works.
+    """
+    from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+    from neuronx_distributed_tpu.inference.engine import run_trace, synthetic_trace
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model,
+        neuronx_distributed_config,
+    )
+
+    out = {}
+    try:
+        if len(jax.devices()) < tp:
+            raise RuntimeError(
+                f"TP section needs >= {tp} devices, have "
+                f"{len(jax.devices())} (CPU runs: set "
+                f"xla_force_host_platform_device_count)")
+        page_size = 16
+        new_tokens = 32
+        ppseq = -(-(prompt_len + new_tokens + fused_steps) // page_size)
+        trace = synthetic_trace(
+            12, lcfg.vocab_size, prompt_lens=(prompt_len,),
+            max_new_tokens=new_tokens, mean_interarrival_blocks=0.5, seed=0)
+
+        def measure(degree):
+            ps.destroy_model_parallel()
+            nxd = neuronx_distributed_config(tensor_parallel_size=degree)
+            model = initialize_parallel_model(
+                nxd, lambda: LlamaForCausalLM(lcfg),
+                jnp.zeros((1, 8), jnp.int32))
+            lm_ = CausalLM(lcfg, model.params, LlamaForCausalLM,
+                           buckets=(prompt_len,), max_batch=max_batch,
+                           page_size=page_size,
+                           page_pool_pages=max_batch * ppseq + max_batch)
+            lm_.compile()
+            # warm the whole admission path outside the measured window
+            # (bench_structured's discipline: staggered submissions
+            # compile every insert width + the fused block first)
+            for rows in range(1, max_batch + 1):
+                lm_._insert_programs(rows, prompt_len)
+            warm = ServeEngine(lm_, block_steps=fused_steps)
+            for i, item in enumerate(trace[:max_batch]):
+                warm.submit(item["prompt"], 2, arrival_block=i // 2)
+            warm.run()
+            eng_ = ServeEngine(lm_, block_steps=fused_steps)
+            rep = run_trace(eng_, trace)
+            streams = {c.request_id: c.tokens.tolist()
+                       for c in eng_.completed}
+            kv = lm_.kv_cache_bytes()
+            return rep, streams, kv
+
+        rep1, s1, kv1 = measure(1)
+        rep2, s2, kv2 = measure(tp)
+        ps.destroy_model_parallel()
+        out["serve_tokens_per_sec_tp1"] = rep1["tokens_per_sec"]
+        out[f"serve_tokens_per_sec_tp{tp}"] = rep2["tokens_per_sec"]
+        if rep1["tokens_per_sec"] and rep2["tokens_per_sec"]:
+            out["serve_tp2_vs_tp1"] = round(
+                rep2["tokens_per_sec"] / rep1["tokens_per_sec"], 3)
+        out["serve_kv_pool_capacity_x_tp"] = round(
+            kv1["kv_bytes"] / kv2["kv_bytes"], 3)
+        out["serve_tp2_stream_equal"] = bool(s1 == s2)
+        if not out["serve_tp2_stream_equal"]:
+            raise RuntimeError(
+                "TP-sharded streams diverged from the TP=1 oracle")
+        out["serve_tp_basis"] = (
+            f"same 12-req paged trace ({prompt_len}-token prompts, "
+            f"{new_tokens} new tokens, 0.5-block arrivals, page_size "
+            f"{page_size}, K={fused_steps}) served at TP=1 and TP={tp} "
+            f"on the {jax.default_backend()} mesh; params born via the "
+            f"seed-0 trainer init in each world (value-identical); "
+            f"streams bit-compared (equality required); capacity = "
+            f"per-chip KV pool bytes TP=1 / TP={tp} "
+            f"(kv_cache_bytes()['kv_bytes'], expect ~x{tp})")
+    except Exception as e:  # noqa: BLE001 — TP section additive, never fatal
+        out["serve_tp2_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
 def bench_sched_soak(scales=(1_000, 100_000, 1_000_000),
                      replicas=100) -> dict:
     """Host-only scheduler scaling curve (see the call site above for the
@@ -2092,13 +2203,17 @@ HEADLINE_KEYS = (
     # serve_itl_p99_ms_unchunked (one-shot-insert contrast basis):
     # sidecar-only since ISSUE 14 (headline size cap)
     "serve_itl_p50_ms", "serve_itl_p99_ms",
-    "serve_decode_stall_ms_longprompt",
+    # serve_decode_stall_ms_longprompt, serve_goodput_1x and
+    # serve_agg_goodput_2x_n4_rr (contrast bases — the chunked stall, the
+    # 2x-vs-1x ratio and the affinity-router number they contrast against
+    # all still gate) moved to the sidecar in ISSUE 16 to make room for
+    # the TP keys under the 2000-byte tail cap
     "serve_decode_stall_ms_longprompt_chunked",
     "serve_itl_p99_ms_disagg", "serve_decode_stall_ms_longprompt_disagg",
-    "serve_goodput_1x", "serve_goodput_2x_overload", "serve_goodput_2x_vs_1x",
+    "serve_goodput_2x_overload", "serve_goodput_2x_vs_1x",
     "serve_deadline_miss_rate_shed", "serve_deadline_miss_rate_noshed",
     "serve_recovery_replay_ms", "serve_tracing_overhead_ratio",
-    "serve_agg_goodput_2x_n4", "serve_agg_goodput_2x_n4_rr",
+    "serve_agg_goodput_2x_n4",
     "serve_tenant_p99_fairness_ratio", "serve_failover_replay_ms",
     "serve_drain_ms",
     "serve_goodput_autoscale_vs_fixed", "serve_scaleup_time_to_ready_blocks",
@@ -2106,6 +2221,12 @@ HEADLINE_KEYS = (
     "adapter_switch_overhead_ms",
     "serve_structured_parse_rate", "serve_itl_p50_ms_structured_vs_freeform",
     "grammar_compile_ms",
+    # TP-sharded serving (ISSUE 16): the TP2/TP1 speedup ratio and the
+    # per-chip pool-capacity multiplication (~xTP, the point of the shard)
+    # gate from the headline; the absolute tp1/tp2 throughputs, the
+    # bit-equality oracle flag and basis string ride the sidecar (the
+    # headline is capped at a 2000-byte tail capture)
+    "serve_tp2_vs_tp1", "serve_kv_pool_capacity_x_tp",
     # fleet-scale scheduler soak (ISSUE 14): the 1M-scale overhead, the
     # 1M-vs-1k sub-linearity ratio and the RSS leak slope gate from the
     # headline; the full per-scale curve (1k/100k/1M) rides the sidecar's
@@ -2118,6 +2239,7 @@ HEADLINE_KEYS = (
     "serve_chunked_error", "serve_overload_error", "serve_router_error",
     "serve_tier_error", "serve_multilora_error", "serve_disagg_error",
     "serve_autoscale_error", "serve_structured_error", "sched_soak_error",
+    "serve_tp2_error",
 )
 
 

@@ -439,6 +439,24 @@ def cmd_serve(args) -> None:
         Router, run_router_trace,
     )
 
+    # TP-sharded serving (serve --tp N): the mesh is built by build_model;
+    # gate the divisibility constraints HERE, before any compile — a head
+    # or vocab count that does not divide TP would silently fall back to
+    # replicated leaves (degraded capacity), which a `--tp N` request
+    # should refuse loudly instead
+    tp = args.tensor_parallel_size or (2 if args.tiny else 8)
+    if tp > 1:
+        cfg0 = build_config(args)
+        for dim_name, dim in (("num_kv_heads", cfg0.num_kv_heads),
+                              ("num_heads", cfg0.num_heads),
+                              ("vocab_size", cfg0.vocab_size)):
+            if dim % tp:
+                raise SystemExit(
+                    f"serve --tp {tp}: {dim_name}={dim} is not divisible "
+                    f"by the TP degree — the KV pool / grammar tables "
+                    f"cannot shard evenly (pick a TP that divides heads "
+                    f"and vocab)")
+
     lm, cfg = build_model(args)
     lm.compile()
 
@@ -478,7 +496,11 @@ def cmd_serve(args) -> None:
     tier_pages = 0
     if lm.paged and not args.no_prefix_cache and not args.no_host_tier:
         if args.host_tier_bytes > 0:
-            tier_pages = max(1, args.host_tier_bytes // lm.kv_page_bytes())
+            # host tier stores GLOBAL-width pages (gather-at-seal), so the
+            # budget divides by the host/handoff page unit, not the
+            # per-shard HBM unit
+            tier_pages = max(1, args.host_tier_bytes
+                             // lm.kv_page_bytes_host())
         else:
             tier_pages = 2 * lm.config.page_pool_pages
     # SLO objectives (observability/slo.py): declarative TTFT/ITL targets

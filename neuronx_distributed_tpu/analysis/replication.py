@@ -1,21 +1,27 @@
 """Rule ``cache-replication``: every cache-returning program routes its
-cache through ``_replicate_out`` at the program boundary.
+cache through a boundary pin — ``_replicate_out`` or its TP-sharded
+counterpart ``_shard_out`` — at the program boundary.
 
 The PR 3 bug class: session caches round-trip between separately
-compiled programs whose inputs are lowered replicated. A program that
-returns a cache WITHOUT the ``_replicate_out`` pin lets GSPMD pick a
-sharded output layout (observed: batch over 'edp' whenever max_batch
+compiled programs whose inputs are lowered with a FIXED layout. A
+program that returns a cache WITHOUT a boundary pin lets GSPMD pick its
+own output layout (observed: batch over 'edp' whenever max_batch
 divides it — trace-shape dependent, so it bit only some schedules), and
 the next AOT call rejects it. The fix pinned every boundary; this rule
-keeps it pinned as new programs are added.
+keeps it pinned as new programs are added. PR 16 added the sharded
+boundary (``_shard_out`` / ``partition.shard_out``): the pinned layout
+is now the DERIVED serving spec rather than forced replication, but the
+invariant is identical — the boundary must pin, never leave GSPMD to
+choose.
 
 Scope: functions passed to ``jax.jit`` (call, decorator, or lambda
 form) — the PROGRAM boundaries. Scan bodies are exempt: their returns
 stay inside the program. A returned tuple element "carries a cache" when
 it mentions a cache-ish identifier (``cache`` / ``t_cache`` /
 ``mut["cache"]`` / ``adapters`` / ``grammars``); such an element must
-have every cache-ish mention inside a ``*._replicate_out(...)`` call or
-a local alias of it (``constrain = self._replicate_out``).
+have every cache-ish mention inside a ``*._replicate_out(...)`` /
+``*._shard_out(...)`` call or a local alias of either
+(``constrain = self._shard_out``).
 """
 
 from __future__ import annotations
@@ -45,13 +51,16 @@ def _cache_mentions(node: ast.AST) -> Iterator[ast.AST]:
             yield from _cache_mentions(child)
 
 
+BOUNDARY_PINS = ("_replicate_out", "replicate_out",
+                 "_shard_out", "shard_out")
+
+
 def _is_replicator(call: ast.Call, aliases: Set[str]) -> bool:
     f = call.func
-    if isinstance(f, ast.Attribute) and f.attr in ("_replicate_out",
-                                                   "replicate_out"):
+    if isinstance(f, ast.Attribute) and f.attr in BOUNDARY_PINS:
         return True
     return isinstance(f, ast.Name) and (
-        f.id in aliases or f.id in ("_replicate_out", "replicate_out"))
+        f.id in aliases or f.id in BOUNDARY_PINS)
 
 
 def _uncovered(elem: ast.AST, aliases: Set[str]) -> bool:
@@ -109,8 +118,9 @@ def _check_file(fc: FileCtx) -> Iterator[Finding]:
                     "cache-replication", fc.rel, elem.lineno,
                     fc.qualname_at(elem),
                     "program boundary returns a cache collection without "
-                    "_replicate_out — GSPMD may hand back a sharded cache "
-                    "the next AOT call rejects (PR 3 class)")
+                    "a _replicate_out/_shard_out pin — GSPMD may hand "
+                    "back a drifted-layout cache the next AOT call "
+                    "rejects (PR 3 class)")
 
 
 def check(ctx: RepoCtx) -> Iterator[Finding]:
@@ -122,8 +132,8 @@ def check(ctx: RepoCtx) -> Iterator[Finding]:
 
 RULE = Rule(
     id="cache-replication",
-    doc="cache-returning jit programs must pin outputs replicated via "
-        "_replicate_out at the program boundary",
+    doc="cache-returning jit programs must pin outputs via "
+        "_replicate_out or _shard_out at the program boundary",
     check=check,
     zero_waiver=True,
 )

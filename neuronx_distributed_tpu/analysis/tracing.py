@@ -164,13 +164,13 @@ def walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
 
 
 def replicator_aliases(tree: ast.AST) -> Set[str]:
-    """Names bound to a ``_replicate_out`` bound method (the
-    ``constrain = self._replicate_out`` idiom)."""
+    """Names bound to a ``_replicate_out`` or ``_shard_out`` bound
+    method (the ``constrain = self._shard_out`` idiom)."""
     out: Set[str] = set()
     for node in ast.walk(tree):
         if (isinstance(node, ast.Assign)
                 and isinstance(node.value, ast.Attribute)
-                and node.value.attr == "_replicate_out"):
+                and node.value.attr in ("_replicate_out", "_shard_out")):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     out.add(tgt.id)

@@ -118,8 +118,14 @@ class AdapterPool:
                 f"adapter), got {n_slots}")
         self.n_slots = int(n_slots)
         self.max_rank = int(max_rank)
-        self.tree = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), avals)
+        # born with the serving shardings (avals come from
+        # CausalLM._adapter_avals, spec-pinned under a TP mesh): the AOT
+        # programs reject a pool whose layout drifted
+        from neuronx_distributed_tpu.inference.partition import (
+            zeros_like_avals,
+        )
+
+        self.tree = zeros_like_avals(avals)
         # leaf name -> (fan_in, fan_out) read off the stack avals
         self.targets: Dict[str, Tuple[int, int]] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(avals)[0]:
@@ -307,7 +313,12 @@ class AdapterPool:
             return leaf.at[:, slot].set(
                 jnp.asarray(view[m.group(1)], leaf.dtype))
 
-        self.tree = jax.tree_util.tree_map_with_path(fix, self.tree)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        # host-side eager .at[].set on a tp-sharded leaf may decommit its
+        # layout — re-pin so the AOT programs keep accepting the pool
+        self.tree = repin(
+            jax.tree_util.tree_map_with_path(fix, self.tree), self.tree)
 
     def _garble_slot(self, slot: int) -> None:
         """Physically corrupt one device byte of the slot (the ``adapter``
@@ -323,7 +334,10 @@ class AdapterPool:
             done = True
             return leaf.at[(0, slot) + (0,) * (leaf.ndim - 2)].set(104729.0)
 
-        self.tree = jax.tree_util.tree_map_with_path(fix, self.tree)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        self.tree = repin(
+            jax.tree_util.tree_map_with_path(fix, self.tree), self.tree)
 
     # --- residency / pinning --------------------------------------------
 

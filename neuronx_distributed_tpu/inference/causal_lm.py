@@ -27,6 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.inference.paged_cache import PagedKVCache
+from neuronx_distributed_tpu.inference.partition import (
+    leaf_partition_spec, shard_avals, shard_out, zeros_like_avals,
+)
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
 PyTree = Any
@@ -341,7 +344,9 @@ class CausalLM:
     def _adapter_avals(self) -> Optional[PyTree]:
         """Abstract ``"adapters"`` collection at session width — the ONE
         canonical aval every adapter-enabled program lowers against (pinned
-        replicated under a mesh, like the cache avals)."""
+        to the serving specs under a mesh, like the cache avals: A fan-in
+        sharded for row-parallel targets, B fan-out sharded for
+        column-parallel ones)."""
         if not self.lora:
             return None
         if self._adapter_avals_cache is None:
@@ -354,16 +359,7 @@ class CausalLM:
                 return mut["adapters"]
 
             avals = jax.eval_shape(shape_fn, self.params, ids0)
-            from neuronx_distributed_tpu.parallel import mesh as ps
-
-            if ps.model_parallel_is_initialized():
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                repl = NamedSharding(ps.get_mesh(), PartitionSpec())
-                avals = jax.tree.map(
-                    lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                                   sharding=repl), avals)
-            self._adapter_avals_cache = avals
+            self._adapter_avals_cache = shard_avals(avals)
         return self._adapter_avals_cache
 
     def new_adapter_pool(self):
@@ -381,8 +377,8 @@ class CausalLM:
         session-less paths like :meth:`generate` feed adapter-enabled
         programs; the correction is exactly zero."""
         if self._identity_adapters_cache is None:
-            self._identity_adapters_cache = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype), self._adapter_avals())
+            self._identity_adapters_cache = zeros_like_avals(
+                self._adapter_avals())
         return self._identity_adapters_cache
 
     def _with_adapter_idx(self, tree: PyTree, idx: jax.Array) -> PyTree:
@@ -454,13 +450,15 @@ class CausalLM:
 
             G, S = self.grammar_slots, self.grammar_states
             V = self.config.vocab_size
-            self._identity_grammars_cache = {
+            # eager shard_out: born vocab-sharded under a TP mesh, so the
+            # AOT grammar-tailed programs never reshard the identity tables
+            self._identity_grammars_cache = shard_out({
                 "need": jnp.concatenate(
                     [jnp.zeros((1, S, V), jnp.int32),
                      jnp.full((G - 1, S, V), _INF, jnp.int32)]),
                 "next": jnp.zeros((G, S, V), jnp.int32),
                 "terminal": jnp.zeros((G, S), bool),
-            }
+            })
         return self._identity_grammars_cache
 
     def _gr_lower(self, rows: int) -> tuple:
@@ -471,11 +469,11 @@ class CausalLM:
             return ()
         G, S = self.grammar_slots, self.grammar_states
         V = self.config.vocab_size
-        tree = {
+        tree = shard_avals({
             "need": jax.ShapeDtypeStruct((G, S, V), jnp.int32),
             "next": jax.ShapeDtypeStruct((G, S, V), jnp.int32),
             "terminal": jax.ShapeDtypeStruct((G, S), jnp.bool_),
-        }
+        })
         return (tree,
                 jax.ShapeDtypeStruct((rows,), jnp.int32),
                 jax.ShapeDtypeStruct((rows,), jnp.int32),
@@ -512,21 +510,24 @@ class CausalLM:
         return jnp.where(ok.any(axis=-1, keepdims=True), ok, fb)
 
     def compile(self) -> "CausalLM":
-        # every cache a program RETURNS is pinned replicated (_replicate_out,
-        # no-op off-mesh): session caches round-trip between AOT programs
-        # whose cache inputs are lowered replicated (_cache_avals) — an
-        # unconstrained output lets GSPMD hand back a sharded cache that the
-        # next call then rejects (batch-over-'edp' whenever max_batch
-        # divides it; trace-shape-dependent, so it bit only some schedules)
+        # every cache a program RETURNS is pinned to the serving specs
+        # (_shard_out, no-op off-mesh): session caches round-trip between
+        # AOT programs whose cache inputs are lowered on the SAME specs
+        # (_cache_avals) — an unconstrained output lets GSPMD pick a layout
+        # the next call then rejects (the PR 3 class: batch-over-'edp'
+        # whenever max_batch divides it; trace-shape-dependent, so it bit
+        # only some schedules). Under a TP mesh the specs shard KV heads /
+        # adapter fan-in-out / grammar vocab (inference/partition.py);
+        # off-mesh or at tp=1 they degrade to the replicated pin.
         def prefill_fn(params, ids, *ad):
             logits, mut = self.model.apply(self._ad_vars(params, None, ad),
                                            ids, mutable=["cache"])
-            return logits, self._replicate_out(mut["cache"])
+            return logits, self._shard_out(mut["cache"])
 
         def decode_fn(params, cache, ids, *ad):
             logits, mut = self.model.apply(self._ad_vars(params, cache, ad),
                                            ids, mutable=["cache"])
-            return logits, self._replicate_out(mut["cache"])
+            return logits, self._shard_out(mut["cache"])
 
         ad0 = self._ad_lower(self.max_batch)
         if not self.paged:
@@ -608,7 +609,7 @@ class CausalLM:
 
             (cache, tok, rng, done), toks = jax.lax.scan(
                 body, (cache, tok, rng, done), None, length=steps)
-            return toks, self._replicate_out(cache), tok, rng, done
+            return toks, self._shard_out(cache), tok, rng, done
 
         cache0 = self._cache_avals()
         tok0 = jnp.zeros((self.max_batch, 1), jnp.int32)
@@ -624,10 +625,11 @@ class CausalLM:
     def _cache_avals(self) -> PyTree:
         """Abstract KV-cache structure at session width (max_batch) — enough
         to lower cache-carrying programs without executing a prefill. When a
-        device mesh is active the avals are PINNED replicated: left
-        unannotated, GSPMD may assign the compiled program sharded cache
-        inputs (observed: batch over 'edp' whenever max_batch divides it),
-        which then reject the replicated session cache at call time."""
+        device mesh is active the avals are PINNED to the serving specs
+        (tp-sharded KV heads, replicated control leaves): left unannotated,
+        GSPMD may assign the compiled program arbitrary cache input layouts
+        (observed: batch over 'edp' whenever max_batch divides it), which
+        then reject the session cache at call time."""
         ids0 = jnp.zeros((self.max_batch, self.buckets[0]), jnp.int32)
 
         def prefill_shape(params, ids):
@@ -639,16 +641,7 @@ class CausalLM:
             return mut["cache"]
 
         avals = jax.eval_shape(prefill_shape, self.params, ids0)
-        from neuronx_distributed_tpu.parallel import mesh as ps
-
-        if not ps.model_parallel_is_initialized():
-            return avals
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        repl = NamedSharding(ps.get_mesh(), PartitionSpec())
-        return jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
-            avals)
+        return shard_avals(avals)
 
     def compile_session_decode_fused(self, steps: int,
                                      slot_sampler: Optional[SlotSampler] = None,
@@ -763,7 +756,7 @@ class CausalLM:
                     else (cache, tok, counts, lengths, done))
             carry, toks = jax.lax.scan(body, init, None, length=steps)
             cache, tok, _counts, lengths, done = carry[:5]
-            return toks, self._replicate_out(cache), tok, lengths, done
+            return toks, self._shard_out(cache), tok, lengths, done
 
         b = self.max_batch
         self._session_fused[key] = self._time_compile(
@@ -787,35 +780,57 @@ class CausalLM:
         raise ValueError(f"prompt length {s} exceeds largest bucket {self.buckets[-1]}")
 
     def kv_cache_bytes(self) -> dict:
-        """KV-cache HBM footprint of this serving config: ``kv_bytes`` is
-        what a session actually allocates (the page pools in paged mode, the
-        ``max_batch x max_seq_len`` slab otherwise); ``kv_slab_bytes`` is the
-        slab-equivalent for the same dims — the memory-sizing formula the
-        README documents (paged/slab = page_pool_pages*page_size /
-        (max_batch*max_seq_len))."""
-        actual = slab = 0
+        """KV-cache footprint of this serving config. ``kv_bytes`` is what
+        a session allocates PER CHIP — the HBM-sizing number: under a TP
+        mesh the KV pools shard their head axis, so each shard holds
+        ``1/tp`` of every sharded leaf (replicated off-mesh / at tp=1 /
+        non-divisible heads: per-chip == global). ``kv_bytes_global`` is
+        the full logical footprint (the host-width number: handoff
+        payloads and host-tier pages gather to full width);
+        ``kv_slab_bytes`` is the per-chip slab-equivalent for the same
+        dims — the memory-sizing formula the README documents (paged/slab
+        = page_pool_pages*page_size / (max_batch*max_seq_len))."""
+        from neuronx_distributed_tpu.parallel import mesh as ps
+
+        tp = (ps.get_tensor_model_parallel_size()
+              if ps.model_parallel_is_initialized() else 1)
+        actual = actual_global = slab = 0
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self._cache_avals())[0]:
             p = jax.tree_util.keystr(path)
             if not (p.endswith("['cached_key']") or p.endswith("['cached_value']")):
                 continue
             nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
-            actual += nbytes
+            spec = leaf_partition_spec(p, leaf.shape, tp)
+            shard_div = tp if any(ax is not None for ax in spec) else 1
+            actual += nbytes // shard_div
+            actual_global += nbytes
             if self.paged:
                 tokens = self.config.page_pool_pages * self.config.page_size
-                slab += nbytes * (self.max_batch * self.config.max_seq_len) // tokens
+                slab += (nbytes // shard_div) * (
+                    self.max_batch * self.config.max_seq_len) // tokens
             else:
-                slab += nbytes
-        return {"kv_bytes": actual, "kv_slab_bytes": slab}
+                slab += nbytes // shard_div
+        return {"kv_bytes": actual, "kv_bytes_global": actual_global,
+                "kv_slab_bytes": slab}
 
     def kv_page_bytes(self) -> int:
-        """Bytes ONE physical KV page occupies across every layer — the
-        host-tier sizing unit (``--host_tier_bytes / kv_page_bytes()`` =
-        tier capacity in pages; the README's HBM-pool + host-tier sizing
-        formula). Paged mode only."""
+        """Bytes ONE physical KV page occupies across every layer ON ONE
+        CHIP — the HBM-pool sizing unit (per-shard under a TP mesh: page
+        capacity per chip-equivalent multiplies by tp). Paged mode only."""
         if not self.paged:
             raise ValueError("kv_page_bytes applies to paged mode only")
         return self.kv_cache_bytes()["kv_bytes"] // self.config.page_pool_pages
+
+    def kv_page_bytes_host(self) -> int:
+        """Bytes one LOGICAL page occupies at full width — the host-tier /
+        handoff sizing unit (``--host_tier_bytes / kv_page_bytes_host()``
+        = tier capacity in pages): page reads gather every shard's slice,
+        so host copies are always full-width regardless of TP degree."""
+        if not self.paged:
+            raise ValueError("kv_page_bytes_host applies to paged mode only")
+        return (self.kv_cache_bytes()["kv_bytes_global"]
+                // self.config.page_pool_pages)
 
     # --- continuous batching (slot-level session API) --------------------
     # The reference reorders sequences into KV-cache slots via its seq_ids
@@ -832,7 +847,9 @@ class CausalLM:
             self.compile()
         cache = self._cache_avals()
         session = DecodeSession(
-            cache=jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache),
+            # born with the serving shardings: the AOT programs were
+            # lowered on these avals and reject a drifted layout
+            cache=zeros_like_avals(cache),
             lengths=np.zeros((self.max_batch,), np.int64),
             active=np.zeros((self.max_batch,), bool),
         )
@@ -881,7 +898,7 @@ class CausalLM:
                     # cover here, but these fresh rows ARE cache avals
                     # crossing a program boundary (no-op off-mesh, and
                     # the reshard is O(rows) either way)
-                    return logits, self._replicate_out(mut["cache"])
+                    return logits, self._shard_out(mut["cache"])
 
                 ids0 = jnp.zeros((rows, bucket), jnp.int32)
                 self._insert_prefill[pkey] = self._time_compile(
@@ -890,13 +907,13 @@ class CausalLM:
                     .lower(self.params, ids0, *self._ad_lower(rows))
                     .compile())
         if rows not in self._insert_scatter:
-            # pin the scatter OUTPUT to replicated: under a TP mesh the
-            # freshly prefilled rows arrive head-sharded, and a plain jit
-            # would propagate that sharding onto the session cache — which
-            # the AOT-compiled session programs (lowered on replicated cache
-            # avals) then reject at their next call. The constraint reshards
+            # pin the scatter OUTPUT to the serving specs: a plain jit
+            # would let GSPMD propagate whatever layout the scatter math
+            # prefers onto the session cache — which the AOT-compiled
+            # session programs (lowered on the serving-spec cache avals)
+            # then reject at their next call. The constraint reshards
             # only the inserted rows (O(rows)), keeping the insert contract.
-            constrain = self._replicate_out
+            constrain = self._shard_out
             self._insert_scatter[rows] = jax.jit(
                 lambda old, fresh, slots, new_len: constrain(
                     _scatter_cache_rows(old, fresh, slots, new_len, rows)),
@@ -906,10 +923,19 @@ class CausalLM:
 
     def _replicate_out(self, tree: PyTree) -> PyTree:
         """Inside-jit constraint forcing every leaf fully replicated when a
-        device mesh is active (no-op otherwise) — session-cache-producing
-        programs must hand back the replicated layout the AOT session
-        programs were lowered with."""
+        device mesh is active (no-op otherwise) — kept for programs whose
+        outputs must stay replicated regardless of the serving specs (and
+        as the historical boundary the static rule also accepts)."""
         return replicate_out(tree)
+
+    def _shard_out(self, tree: PyTree) -> PyTree:
+        """Inside-jit constraint pinning every leaf of a returned serving
+        collection to its derived TP spec (no-op off-mesh) — session-cache-
+        producing programs must hand back exactly the layout the AOT
+        session programs were lowered with (``_cache_avals`` /
+        ``_adapter_avals`` / ``_gr_lower`` pin the inputs; this pins the
+        outputs; inference/partition.py is the one spec source)."""
+        return shard_out(tree)
 
     def _paged_insert_programs(self, rows: int, bucket: int):
         """Lazily compile the paged insert for ``rows`` prompts at suffix
@@ -963,7 +989,7 @@ class CausalLM:
                     return out
                 return new  # mutated pool leaves
 
-            return logits, self._replicate_out(
+            return logits, self._shard_out(
                 jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
 
         self._paged_insert[key] = self._time_compile(
@@ -1031,7 +1057,7 @@ class CausalLM:
                         slots[i], axis=1)
                 return out
 
-            return logits, self._replicate_out(
+            return logits, self._shard_out(
                 jax.tree_util.tree_map_with_path(back, cache, mut["cache"]))
 
         self._chunk_extend[key] = self._time_compile(

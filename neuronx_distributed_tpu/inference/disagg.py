@@ -100,6 +100,12 @@ class KVHandoff:
     payloads: List[Dict[str, np.ndarray]]
     crcs: List[int] = dataclasses.field(default_factory=list)
     src_replica: Optional[int] = None
+    # TP degree of the SEALING worker. Payloads are gathered-at-seal
+    # (full KV width — `_read_pages_bytes` reads the logical page, not a
+    # shard), so the bytes themselves are degree-independent; the stamp
+    # exists so an adopter on a DIFFERENT degree rejects structurally
+    # (degrade-to-re-prefill) instead of trusting framing it can't check.
+    tp_degree: int = 1
 
     def seal(self) -> "KVHandoff":
         self.crcs = [HostPageTier._crc(p) for p in self.payloads]

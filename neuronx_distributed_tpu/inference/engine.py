@@ -2058,8 +2058,13 @@ class ServeEngine:
                     jnp.asarray(data[p], leaf.dtype))
             return leaf
 
-        self.session.cache = jax.tree_util.tree_map_with_path(
-            fix, self.session.cache)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        # host-side eager scatters on tp-sharded pool leaves may decommit
+        # the serving layout — re-pin so the AOT programs keep accepting
+        # the cache (partition.repin is a no-op when nothing drifted)
+        self.session.cache = repin(jax.tree_util.tree_map_with_path(
+            fix, self.session.cache), self.session.cache)
 
     def _io_pad(self, pages: List[int]) -> List[int]:
         """Pad a page-id list to the slot's full page count by REPEATING
@@ -2107,8 +2112,10 @@ class ServeEngine:
                 return leaf.at[:, idx].set(stacked)
             return leaf
 
-        self.session.cache = jax.tree_util.tree_map_with_path(
-            fix, self.session.cache)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        self.session.cache = repin(jax.tree_util.tree_map_with_path(
+            fix, self.session.cache), self.session.cache)
 
     def _corrupt_page_bytes(self, pages: List[int]) -> None:
         """Physically garble the K/V pool bytes of ``pages`` in every layer.
@@ -2122,8 +2129,10 @@ class ServeEngine:
                     leaf = leaf.at[:, pg].set(jnp.asarray(104729.0, leaf.dtype))
             return leaf
 
-        self.session.cache = jax.tree_util.tree_map_with_path(
-            fix, self.session.cache)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        self.session.cache = repin(jax.tree_util.tree_map_with_path(
+            fix, self.session.cache), self.session.cache)
 
     def inject_page_corruption(self, pages: List[int]) -> None:
         """Public corruption seam (ops drills / tests): declare ``pages``
@@ -2230,9 +2239,11 @@ class ServeEngine:
         landed on the first sample — keep their state and retire locally
         with a normal completion: there is nothing left to decode."""
         from neuronx_distributed_tpu.inference.disagg import KVHandoff
+        from neuronx_distributed_tpu.inference.partition import tp_degree
 
         pkv = self.session.paged
         ps = pkv.page_size
+        tp = tp_degree()
         for slot in slot_ids:
             req = self.slots[slot]
             if req is None or self._done[slot]:
@@ -2245,7 +2256,7 @@ class ServeEngine:
             ts_list = self._out_ts.get(rid) or [time.perf_counter()]
             h = KVHandoff(req=req, first_token=first,
                           first_ts=float(ts_list[0]), page_size=ps,
-                          payloads=payloads)
+                          payloads=payloads, tp_degree=tp)
             h.seal()
             self.outbox.append(h)
             self.stats["handoffs_sent"] += 1
@@ -2303,6 +2314,22 @@ class ServeEngine:
         if not self._pool_can_admit(req.prompt.size, req.max_new_tokens):
             self._note_pool_pressure([req])
             return "deferred"
+        from neuronx_distributed_tpu.inference.partition import tp_degree
+        my_tp = tp_degree()
+        if getattr(h, "tp_degree", 1) != my_tp:
+            # structured cross-degree rejection: the framing was sealed
+            # under a different TP degree, and an adopter has no way to
+            # validate foreign-degree framing assumptions — degrade to a
+            # local re-prefill (bit-identical per the rng contract)
+            # instead of corrupting the pool silently
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "migrate:tp_mismatch", (self.lane, "migrate"),
+                    block=self.blocks,
+                    args={"rid": req.request_id,
+                          "src_tp": int(getattr(h, "tp_degree", 1)),
+                          "dst_tp": int(my_tp)})
+            return "degraded"
         if not h.verify():
             if self.tracer.enabled:
                 self.tracer.instant(
@@ -3544,8 +3571,19 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             "evicted_pages": pkv.stats["evicted_pages"],
             "deferred_admissions": engine.stats["deferred_admissions"],
             "kv_hbm_bytes": kv["kv_bytes"],
+            "kv_hbm_bytes_global": kv["kv_bytes_global"],
             "kv_slab_hbm_bytes": kv["kv_slab_bytes"],
             "kv_hbm_vs_slab": round(kv["kv_bytes"] / kv["kv_slab_bytes"], 3),
+        })
+        from neuronx_distributed_tpu.inference.partition import (
+            sharded_fraction, tp_degree,
+        )
+        report.update({
+            # TP-sharded serving surface: per-chip vs global KV bytes is
+            # the capacity-multiplication evidence (ISSUE 16)
+            "tp_degree": tp_degree(),
+            "kv_sharded_fraction": round(
+                sharded_fraction(engine.session.cache), 3),
         })
         if pkv.tier is not None:
             # host-tier surface: the spill/restore/repair cycle plus what

@@ -665,13 +665,18 @@ class GrammarPool:
         # (dist[next[s, v]]; _INF = forbidden) — ONE fused table instead of
         # separate mask + dist gathers, keeping the in-scan cost to a
         # single (b, vocab) gather plus two compares per step.
-        self.tree = {
+        # Born spec-pinned (vocab-sharded need/next under a TP mesh, so the
+        # per-shard mask meets the vocab-sharded logits pre-gather): the
+        # eager shard_out is a device_put off-trace and a no-op off-mesh.
+        from neuronx_distributed_tpu.inference.partition import shard_out
+
+        self.tree = shard_out({
             "need": jnp.concatenate(
                 [jnp.zeros((1, S, V), jnp.int32),
                  jnp.full((G - 1, S, V), _INF, jnp.int32)]),
             "next": jnp.zeros((G, S, V), jnp.int32),
             "terminal": jnp.zeros((G, S), bool),
-        }
+        })
         self.allocator = PageAllocator(self.n_slots, reserved=1)
         self.resident: Dict[str, int] = {}
         self._registry: Dict[str, dict] = {}
@@ -834,11 +839,15 @@ class GrammarPool:
                 for k in _LEAVES}
 
     def _write_slot(self, slot: int, entry: dict) -> None:
+        from neuronx_distributed_tpu.inference.partition import repin
+
         view = entry["view"]
-        self.tree = {
+        # re-pin after the host-side eager update: a .at[slot].set on a
+        # vocab-sharded table may decommit the layout the AOT programs pin
+        self.tree = repin({
             k: self.tree[k].at[slot].set(
                 jnp.asarray(view[k], self.tree[k].dtype))
-            for k in _LEAVES}
+            for k in _LEAVES}, self.tree)
 
     def _garble_slot(self, slot: int) -> None:
         """Physically corrupt one device entry of the slot's mask table
@@ -846,9 +855,12 @@ class GrammarPool:
         checksum must catch it; the repair rewrites from the registry. A
         corrupted mask is exactly the failure that would emit an
         out-of-grammar token, which must never happen."""
-        self.tree = dict(self.tree)
-        self.tree["need"] = self.tree["need"].at[slot, 0, 0].add(104729)
-        self.tree["next"] = self.tree["next"].at[slot, 0, 0].add(7)
+        from neuronx_distributed_tpu.inference.partition import repin
+
+        garbled = dict(self.tree)
+        garbled["need"] = garbled["need"].at[slot, 0, 0].add(104729)
+        garbled["next"] = garbled["next"].at[slot, 0, 0].add(7)
+        self.tree = repin(garbled, self.tree)
 
     # --- residency / pinning --------------------------------------------
 

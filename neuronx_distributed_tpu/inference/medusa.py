@@ -38,8 +38,8 @@ from neuronx_distributed_tpu.inference.causal_lm import (
     _set_cache_index,
     infer_prompt_lengths,
     percentile_ms,
-    replicate_out,
 )
+from neuronx_distributed_tpu.inference.partition import shard_out
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaModel
 from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
 from neuronx_distributed_tpu.parallel.partitioning import ACT_FULL, constrain
@@ -223,11 +223,11 @@ def medusa_generate(
     def prefill(params, ids):
         (logits, med), mut = model.apply({"params": params}, ids, None,
                                          mutable=["cache"])
-        # program-boundary pin (causal_lm.replicate_out): the cache
+        # program-boundary pin (partition.shard_out): the cache
         # round-trips between these three separately compiled programs —
-        # an unconstrained output lets GSPMD hand back a sharded cache
+        # an unconstrained output lets GSPMD hand back a layout
         # the next call rejects (the PR 3 class; medusa predated the fix)
-        return logits, med, replicate_out(mut["cache"])
+        return logits, med, shard_out(mut["cache"])
 
     # donate the cache like every other decode-path program (CausalLM.compile,
     # the speculative proposer): the KV cache is the dominant allocation
@@ -237,14 +237,14 @@ def medusa_generate(
             {"params": params, "cache": cache}, tree_tokens,
             (chunk_mask, chunk_pos), heads=False, mutable=["cache"],
         )
-        return logits, replicate_out(mut["cache"])
+        return logits, shard_out(mut["cache"])
 
     @partial(jax.jit, donate_argnums=(1,))
     def replay(params, cache, tokens):
         (logits, med), mut = model.apply(
             {"params": params, "cache": cache}, tokens, None, mutable=["cache"]
         )
-        return logits, med, replicate_out(mut["cache"])
+        return logits, med, shard_out(mut["cache"])
 
     ids = np.zeros((1, bucket), np.int32)
     ids[0, :s] = prompt_ids[0]

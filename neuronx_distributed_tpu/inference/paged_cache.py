@@ -48,6 +48,16 @@ last prompt token always stays in the suffix, so the divergence page is
 recomputed privately), and every write — suffix prefill, decode, padding
 garbage — lands in privately owned or scratch pages. A shared page is
 therefore immutable until its refcount drains to zero.
+
+TP sharding (PR 16): everything in this module is SHARD-AGNOSTIC. Under a
+``tp`` mesh the device pools are sharded over the KV-head axis
+(``inference/partition.py``), but one LOGICAL page id still maps to one
+slice of every shard — block tables, refcounts, the radix trie and the
+plan/commit lifecycle all key on logical ids and never see a shard. Only
+the byte-accounting callers must pick a basis: per-chip budgets size with
+``CausalLM.kv_page_bytes()`` (divided by the TP degree), while the host
+tier and KVHandoff payloads hold GLOBAL-width pages (gather-at-seal) and
+size with ``kv_page_bytes_host()``.
 """
 
 from __future__ import annotations

@@ -94,11 +94,11 @@ def _make_proposer(draft: CausalLM, num_draft: int, greedy: bool, temperature: f
     def proposer(params, cache, last_tok, rng):
         toks, probs, cache = _propose(draft, num_draft, greedy, temperature,
                                       params, cache, last_tok, rng)
-        # cache outputs pin replicated at every program boundary (see
-        # CausalLM._replicate_out): the cache round-trips between separately
-        # compiled programs whose inputs are replicated — an unconstrained
-        # output lets GSPMD hand back a sharded cache the next call rejects
-        return toks, probs, draft._replicate_out(cache)
+        # cache outputs pin the serving specs at every program boundary
+        # (CausalLM._shard_out): the cache round-trips between separately
+        # compiled programs lowered on the same specs — an unconstrained
+        # output lets GSPMD hand back a layout the next call rejects
+        return toks, probs, draft._shard_out(cache)
 
     return jax.jit(proposer, donate_argnums=(1,))
 
@@ -225,10 +225,10 @@ def _build_round_block(target: CausalLM, draft: CausalLM, num_draft: int,
         carry, (toks, keeps, accs) = jax.lax.scan(
             round_body, carry, None, length=rounds)
         t_cache, d_cache, last_tok, cur_len, emitted, done, rng = carry
-        # program-boundary pin (CausalLM._replicate_out): both caches feed
+        # program-boundary pin (CausalLM._shard_out): both caches feed
         # this same compiled block again next call — outputs must hand back
-        # the replicated layout the block was lowered with
-        return (target._replicate_out(t_cache), draft._replicate_out(d_cache),
+        # the serving-spec layout the block was lowered with
+        return (target._shard_out(t_cache), draft._shard_out(d_cache),
                 last_tok, cur_len, emitted, done, rng,
                 toks, keeps, accs)
 
@@ -425,9 +425,9 @@ def speculative_generate(
             {"params": target._resolve(params), "cache": cache}, ids,
             mutable=["cache"]
         )
-        # program-boundary pin (CausalLM._replicate_out): the cache feeds
+        # program-boundary pin (CausalLM._shard_out): the cache feeds
         # this same AOT program again next round
-        return logits, target._replicate_out(mut["cache"])
+        return logits, target._shard_out(mut["cache"])
 
     b = target.max_batch
     s = prompt_ids.shape[1]

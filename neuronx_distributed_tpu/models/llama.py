@@ -483,8 +483,18 @@ class LlamaAttention(nn.Module):
             vf = cv.value.reshape(npages * ps, n_kv, hd)
             kf = kf.at[flat].set(k.astype(kf.dtype), mode="drop")
             vf = vf.at[flat].set(v.astype(vf.dtype), mode="drop")
-            ck.value = kf.reshape(npages, ps, n_kv, hd)
-            cv.value = vf.reshape(npages, ps, n_kv, hd)
+            # pin the pool's serving spec at the write (n_kv over 'tp'
+            # under a mesh, no-op otherwise): page-axis scatters/gathers
+            # never cross the head shard, so the whole paged hot path
+            # stays local per shard (inference/partition.py)
+            from neuronx_distributed_tpu.inference.partition import (
+                constrain_named,
+            )
+
+            ck.value = constrain_named(
+                "cached_key", kf.reshape(npages, ps, n_kv, hd))
+            cv.value = constrain_named(
+                "cached_value", vf.reshape(npages, ps, n_kv, hd))
             # in-scan gather: the (b, max_seq_len) logical view the attention
             # below consumes. Stale bytes in reused pages sit behind the
             # position mask exactly like the slab's unwritten zeros (masked
@@ -499,10 +509,18 @@ class LlamaAttention(nn.Module):
             # pad tail runs past max_seq_len must discard those writes, not
             # clamp them onto the last slot) — this is jax's default for
             # scatters, made explicit so the contract can't drift
-            ck.value = ck.value.at[rows, slots].set(
-                k.astype(ck.value.dtype), mode="drop")
-            cv.value = cv.value.at[rows, slots].set(
-                v.astype(cv.value.dtype), mode="drop")
+            from neuronx_distributed_tpu.inference.partition import (
+                constrain_named,
+            )
+
+            # same serving-spec pin as the paged pool: the slab's n_kv
+            # axis shards over 'tp' and the row scatter is shard-local
+            ck.value = constrain_named(
+                "cached_key", ck.value.at[rows, slots].set(
+                    k.astype(ck.value.dtype), mode="drop"))
+            cv.value = constrain_named(
+                "cached_value", cv.value.at[rows, slots].set(
+                    v.astype(cv.value.dtype), mode="drop"))
             k_all, v_all = ck.value, cv.value
         ci.value = idx + s_new
         if chunk_mask is not None:

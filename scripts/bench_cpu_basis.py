@@ -40,6 +40,16 @@ one tiny-dims model and re-measures just ``bench.bench_structured``:
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
         --structured-update BENCH_r07.json BENCH_r08.json
+
+TP-sharded serving refresh (ISSUE 16): the TP keys
+(``serve_tokens_per_sec_tp{1,2}``, ``serve_tp2_vs_tp1``,
+``serve_kv_pool_capacity_x_tp``) need a multi-device mesh, so
+``--tp-update`` forces an 8-virtual-device CPU host platform (set BEFORE
+jax import) and re-measures just ``bench.bench_serving_tp`` at the same
+tiny dims:
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --tp-update BENCH_r08.json BENCH_r09.json
 """
 
 from __future__ import annotations
@@ -151,11 +161,72 @@ def _structured_update(base_path: str, out_path: str) -> int:
     return 0
 
 
+def _tp_update(base_path: str, out_path: str) -> int:
+    """BENCH_r0(x+1) = BENCH_r0x + freshly measured TP-sharded-serving
+    keys (ISSUE 16: the keys need >= 2 devices, which no committed
+    artifact's run had — they would sit ungated as new_key forever).
+    Forces an 8-virtual-device CPU host platform (the tests' mesh), then
+    runs just ``bench.bench_serving_tp`` at the shared tiny dims — the
+    section manages its own TP=1/TP=2 worlds internally."""
+    import os
+
+    # must land before ANY jax import in this process
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+
+    prompt_len, max_batch = 128, 4
+    lcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=prompt_len + 256, dtype=jnp.float32,
+        param_dtype=jnp.float32, use_flash_attention=False,
+        remat_policy=None)
+    tp_keys = bench.bench_serving_tp(lcfg, prompt_len=prompt_len,
+                                     max_batch=max_batch, fused_steps=16)
+    parsed.update(tp_keys)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | TP keys measured by --tp-update (8 virtual CPU devices) on "
+        + "top of " + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--tp-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in tp_keys if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) >= 4 and sys.argv[1] == "--sched-update":
         return _sched_update(sys.argv[2], sys.argv[3])
     if len(sys.argv) >= 4 and sys.argv[1] == "--structured-update":
         return _structured_update(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 4 and sys.argv[1] == "--tp-update":
+        return _tp_update(sys.argv[2], sys.argv[3])
 
     import jax.numpy as jnp
 

@@ -34,6 +34,18 @@ def build_bad_decode(model):
     return jax.jit(decode_fn, donate_argnums=(1,))
 
 
+def build_bad_sharded_decode(model, mesh, spec):
+    # sharding constraints INSIDE the program do not cover the boundary:
+    # the returned cache is still unpinned, so the rule must keep firing
+    # (the PR 16 sharded-serving variant of the PR 3 class)
+    def decode_fn(params, cache, ids):
+        logits, mut = model.apply({"params": params, "cache": cache}, ids,
+                                  mutable=["cache"])
+        logits = jax.lax.with_sharding_constraint(logits, spec)
+        return logits, mut["cache"]    # bare cache from a sharded program
+    return jax.jit(decode_fn, donate_argnums=(1,))
+
+
 def build_bad_loop(model):
     def fn(params, xs):
         total = jnp.zeros(())

@@ -52,3 +52,31 @@ def build_good_alias(lm):
     constrain = lm._replicate_out
     return jax.jit(
         lambda cache, fresh: constrain(cache), donate_argnums=(0,))
+
+
+def shard_out(tree):
+    return tree
+
+
+def build_good_sharded_decode(model, lm):
+    # the PR 16 boundary: the TP-sharded pin is as valid as replication
+    def decode_fn(params, cache, ids):
+        logits, mut = model.apply({"params": params, "cache": cache}, ids,
+                                  mutable=["cache"])
+        return logits, lm._shard_out(mut["cache"])
+    return jax.jit(decode_fn, donate_argnums=(1,))
+
+
+def build_good_sharded_scan(model):
+    def fn(params, cache, tok):
+        (cache, tok), toks = jax.lax.scan(
+            lambda c, _: (c, c[1]), (cache, tok), None, length=4)
+        return toks, shard_out(cache)      # module-fn form of the pin
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def build_good_sharded_alias(lm):
+    # `constrain = <lm>._shard_out` — the sharded twin of the alias idiom
+    constrain = lm._shard_out
+    return jax.jit(
+        lambda cache, fresh: constrain(cache), donate_argnums=(0,))

@@ -98,6 +98,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_itl_p50_ms_freeform": 6.28,
                                       "serve_structured_requests": 6,
                                       "grammar_bytes_per_slot": 15360000,
+                                      "serve_tokens_per_sec_tp1": 500.0,
+                                      "serve_tokens_per_sec_tp2": 905.0,
+                                      "serve_tp2_vs_tp1": 1.81,
+                                      "serve_kv_pool_capacity_x_tp": 2.0,
+                                      "serve_tp2_stream_equal": True,
+                                      "serve_tp_basis": "8 virtual cpu",
                                       "router_sched_overhead_us_per_request": 62.0,
                                       "router_sched_overhead_us_per_request_1k": 55.0,
                                       "router_sched_overhead_us_per_request_100k": 60.0,
@@ -166,8 +172,11 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert h["serve_itl_p99_ms"] < d["serve_itl_p99_ms_unchunked"]
     assert "serve_itl_p99_ms_unchunked" not in h
     assert h["serve_decode_stall_ms_longprompt_chunked"] == 9.5
+    # the unchunked stall (contrast basis) is sidecar-only since ISSUE 16
+    # (headline size cap — the chunked claim key still gates)
     assert h["serve_decode_stall_ms_longprompt_chunked"] < \
-        h["serve_decode_stall_ms_longprompt"]
+        d["serve_decode_stall_ms_longprompt"]
+    assert "serve_decode_stall_ms_longprompt" not in h
     # disaggregation keys (ISSUE 11): decode ITL with zero prefill sharing
     # must beat the chunked baseline, and the long-prompt stall EXCESS on
     # the decode clock is ~0 — chunking bounds interference,
@@ -200,12 +209,18 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
         h["serve_deadline_miss_rate_noshed"]
     assert h["serve_goodput_2x_vs_1x"] >= 0.9
     assert h["serve_recovery_replay_ms"] == 118.0
+    # the 1x goodput (contrast basis of the 2x-vs-1x ratio, which gates)
+    # is sidecar-only since ISSUE 16 (headline size cap)
+    assert "serve_goodput_1x" not in h and d["serve_goodput_1x"] == 540.0
     # multi-replica router keys (ISSUE 7): the N=4 aggregate goodput must
     # beat the round-robin baseline on both surfaces, the compliant
     # tenant's p99 fairness ratio stays under the 1.2x isolation bound,
     # and the failover/drain wall costs ride the headline
     assert d["serve_agg_goodput_2x_n4"] == h["serve_agg_goodput_2x_n4"]
-    assert h["serve_agg_goodput_2x_n4"] > h["serve_agg_goodput_2x_n4_rr"]
+    # the round-robin contrast basis is sidecar-only since ISSUE 16
+    # (headline size cap — the affinity number still gates)
+    assert h["serve_agg_goodput_2x_n4"] > d["serve_agg_goodput_2x_n4_rr"]
+    assert "serve_agg_goodput_2x_n4_rr" not in h
     assert h["serve_tenant_p99_fairness_ratio"] <= 1.2
     assert h["serve_failover_replay_ms"] == 145.0
     assert h["serve_drain_ms"] == 96.0
@@ -645,6 +660,90 @@ def test_bench_regress_committed_r08_gates_structured_keys(tmp_path):
     rc, summary, _ = _regress(REPO / "BENCH_r08.json", tmp_path / "bad.json")
     assert rc == 1
     assert "serve_structured_parse_rate" in \
+        [r["key"] for r in summary["regressions"]]
+
+
+def test_report_tp_keys(monkeypatch, capsys, tmp_path):
+    """ISSUE 16 satellite: the TP-sharded-serving keys ride the report
+    (mocked serving section) — the TP2/TP1 speedup ratio and per-chip
+    KV-pool capacity multiplier are the gate-bearing quantities on the
+    headline; the absolute throughputs stay in the sidecar."""
+    d, h = _run_main(monkeypatch, capsys, tmp_path,
+                     {1: 0.263, 2: 0.463, 3: 0.663, 4: 0.863})
+    for key in ("serve_tp2_vs_tp1", "serve_kv_pool_capacity_x_tp"):
+        assert key in h, key
+        assert h[key] == d[key]
+    # absolute throughputs, exactness flag + basis note stay in the
+    # SIDECAR (headline is size-capped; the ratio already gates, the
+    # absolutes and the flag are forensic)
+    for key in ("serve_tokens_per_sec_tp1", "serve_tokens_per_sec_tp2",
+                "serve_tp2_stream_equal", "serve_tp_basis"):
+        assert key in d and key not in h
+    assert d["serve_tp2_stream_equal"] is True
+    assert h["serve_kv_pool_capacity_x_tp"] >= 1.9
+
+
+def test_bench_regress_tp_direction_rules(tmp_path):
+    """Direction-of-goodness for the TP keys: a FALLING TP2/TP1 speedup
+    or capacity multiplier regresses (higher-is-better both); the speedup
+    gets a generous shared-box tolerance, the capacity multiplier a tight
+    structural one — halving the pool is geometry, not wall clock."""
+    keys = ["serve_tp2_vs_tp1", "serve_kv_pool_capacity_x_tp"]
+    base = {"headline_keys": keys,
+            "serve_tp2_vs_tp1": 1.8,
+            "serve_kv_pool_capacity_x_tp": 2.0}
+    worse = {"headline_keys": keys,
+             "serve_tp2_vs_tp1": 1.8,
+             "serve_kv_pool_capacity_x_tp": 1.5}
+    noisy = {"headline_keys": keys,
+             "serve_tp2_vs_tp1": 1.45,
+             "serve_kv_pool_capacity_x_tp": 2.0}
+    blown = {"headline_keys": keys,
+             "serve_tp2_vs_tp1": 0.9,
+             "serve_kv_pool_capacity_x_tp": 2.0}
+    for name, doc in (("base", base), ("worse", worse), ("noisy", noisy),
+                      ("blown", blown)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "worse.json")
+    assert rc == 1
+    assert [r["key"] for r in summary["regressions"]] == \
+        ["serve_kv_pool_capacity_x_tp"]
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "noisy.json")
+    assert rc == 0, "20% speedup noise must not gate"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "blown.json")
+    assert rc == 1
+    assert [r["key"] for r in summary["regressions"]] == ["serve_tp2_vs_tp1"]
+
+
+def test_bench_regress_committed_r09_gates_tp_keys(tmp_path):
+    """ISSUE 16 satellite: BENCH_r09 (scripts/bench_cpu_basis.py
+    --tp-update over r08, 8 virtual CPU devices) carries the TP-sharded
+    serving keys no prior artifact could (single-device runs). Self-pass,
+    r08 -> r09 lands them as new_key, the committed capacity multiplier
+    meets the >= 1.9 acceptance bar with streams bit-equal, and an
+    injected capacity drop exits 1 naming the key."""
+    doc = json.loads((REPO / "BENCH_r09.json").read_text())
+    assert doc["rc"] == 0 and "--tp-update" in doc["cmd"]
+    p = doc["parsed"]
+    for key in ("serve_tokens_per_sec_tp1", "serve_tokens_per_sec_tp2",
+                "serve_tp2_vs_tp1", "serve_kv_pool_capacity_x_tp"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    # the acceptance criteria, pinned on the committed artifact
+    assert p["serve_kv_pool_capacity_x_tp"] >= 1.9
+    assert p["serve_tp2_stream_equal"] is True
+    rc, summary, err = _regress(REPO / "BENCH_r09.json",
+                                REPO / "BENCH_r09.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    rc, summary, _ = _regress(REPO / "BENCH_r08.json",
+                              REPO / "BENCH_r09.json")
+    assert rc == 0, "new TP keys must land as new_key over r08"
+    bad = dict(doc, parsed=dict(p, serve_kv_pool_capacity_x_tp=1.0))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r09.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert "serve_kv_pool_capacity_x_tp" in \
         [r["key"] for r in summary["regressions"]]
 
 

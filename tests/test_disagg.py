@@ -355,5 +355,38 @@ def test_kv_handoff_seal_verify_corrupt():
                   payloads=[payload]).seal()
     assert h.verify()
     assert h.pages == 1 and h.nbytes() == 96
+    assert h.tp_degree == 1    # off-mesh framing records the degree
     h.corrupt()
     assert not h.verify()      # the flip is real and the checksum sees it
+
+
+def test_adopt_rejects_tp_degree_mismatch(lm_p):
+    """ISSUE 16 satellite: a handoff whose framing was sealed under a
+    DIFFERENT TP degree is rejected structurally on adopt — degraded to a
+    local re-prefill (bit-identical by the rng contract), never written
+    into the pool. The rejection is the degree check, not the checksum:
+    every forged handoff still verifies clean."""
+    submits = _mixed_submits()
+    oracle = _oracle(lm_p, submits)
+    router = DisaggRouter(lm_p, 2, prefill_replicas=1,
+                          rng=jax.random.key(42), block_steps=K)
+    dec = router.engines[1]
+    orig, verdicts = dec.adopt_handoff, []
+
+    def forge(h):
+        assert h.tp_degree == 1        # stamped by the sealing worker
+        h.tp_degree = 4                # ...now claim a foreign degree
+        out = orig(h)
+        verdicts.append((out, h.verify()))
+        return out
+
+    dec.adopt_handoff = forge
+    for kw in submits:
+        router.submit(**kw)
+    router.run(max_blocks=300)
+    assert _streams(router) == oracle
+    assert router.stats["handoffs_degraded"] == len(submits)
+    assert router.stats["handoffs_adopted"] == 0
+    # "degraded" with clean bytes == the structured cross-degree rejection
+    assert verdicts and all(v == ("degraded", True) for v in verdicts)
+    _drained_to_zero(router)

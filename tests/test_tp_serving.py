@@ -1,0 +1,222 @@
+"""TP-sharded serving exactness oracle (ISSUE 16 tentpole gates).
+
+THE acceptance gate: the SAME serving workload — paged KV, a mixed
+multi-LoRA pool, structured (grammar-constrained) streams, greedy and
+sampled rows side by side — produces BIT-IDENTICAL token streams on a
+TP=2 CPU mesh (KV pools sharded over heads, adapter stacks over
+fan-in/fan-out, grammar tables over vocab) and on the TP=1 baseline,
+through both the fused K-step scan and the stepwise engine, and through
+the disagg KVHandoff/adopt seam. Plus the capacity claim the sharding
+exists for: per-chip KV pool bytes HALVE at TP=2 (the ×TP pool
+multiplication), and the spec layer's divisibility fallback degrades to
+replicated — never to a wrong answer.
+
+World discipline: the autouse ``_reset_parallel_state`` fixture tears the
+mesh down after every test, so each test re-enters its world through
+``_world(tp)`` before touching a stack; compiled stacks are cached per TP
+degree (jax interns ``Mesh`` objects, so a re-initialized identical mesh
+is THE same mesh the programs were lowered under).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import (
+    CausalLM,
+    DisaggRouter,
+    Sampler,
+    ServeEngine,
+)
+from neuronx_distributed_tpu.inference.partition import (
+    leaf_partition_spec,
+    serving_partition_specs,
+    sharded_fraction,
+)
+from neuronx_distributed_tpu.lora import LoraConfig, init_lora
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from neuronx_distributed_tpu.parallel import mesh as psm
+from neuronx_distributed_tpu.trainer import (
+    initialize_parallel_model,
+    neuronx_distributed_config,
+)
+
+TINY = dict(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, kv_size_multiplier=1, max_seq_len=64,
+    dtype=jnp.float32, use_flash_attention=False, remat_policy=None,
+)
+K = 4
+PAGE = 4
+RANK = 4
+ACFG = LoraConfig(r=RANK, lora_alpha=8.0)
+SPECS = {"gnum": {"regex": "-?[0-9]{1,3}"}, "gab": {"regex": "a[ab]*b"}}
+
+_STACKS = {}
+
+
+def _world(tp):
+    """Enter the TP world: a fresh mesh at degree ``tp`` (jax interns
+    Mesh, so re-entry yields the object the cached stack was lowered
+    under)."""
+    psm.destroy_model_parallel()
+    psm.initialize_model_parallel(tensor_model_parallel_size=tp)
+
+
+def _stack(tp):
+    """The full-featured serving stack for one TP degree: paged + LoRA +
+    grammar, params born on the mesh via the trainer's deterministic
+    seed-0 init (value-identical across degrees — the oracle's premise)."""
+    _world(tp)
+    if tp not in _STACKS:
+        cfg = LlamaConfig(**TINY)
+        nxd = neuronx_distributed_config(tensor_parallel_size=tp)
+        model = initialize_parallel_model(
+            nxd, lambda: LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+        lm = CausalLM(cfg, model.params, LlamaForCausalLM, buckets=(8, 16),
+                      max_batch=3, page_size=PAGE, lora_rank=RANK,
+                      lora_slots=3, grammar_slots=3,
+                      grammar_states=48).compile()
+        ads = {f"a{i}": _mk_adapter(lm.params, i) for i in range(2)}
+        _STACKS[tp] = (lm, ads)
+    return _STACKS[tp]
+
+
+def _mk_adapter(params, i):
+    """Adapter-distinct nonzero B (B=0 would make the pool the identity
+    and the multi-LoRA leg of the oracle vacuous); fixed keys make the
+    values identical across TP worlds."""
+    ad = init_lora(params, ACFG, jax.random.key(10 + i))
+    return {k: {"lora_a": v["lora_a"],
+                "lora_b": 0.05 * jax.random.normal(
+                    jax.random.fold_in(jax.random.key(20 + i), j),
+                    v["lora_b"].shape, jnp.float32)}
+            for j, (k, v) in enumerate(sorted(ad.items()))}
+
+
+def _prompts(n, s=8, seed=5):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n, s), 1, 127))
+
+
+P = _prompts(4)
+
+# the full-feature matrix in one schedule: greedy/sampled × freeform/
+# adapter/grammar rows decoding in NEIGHBOURING slots of one pool
+SUBMITS = [
+    dict(prompt=P[0], max_new_tokens=8),
+    dict(prompt=P[1], max_new_tokens=6, sampler=Sampler(temperature=0.9),
+         adapter="a0", arrival_block=1),
+    dict(prompt=P[2], max_new_tokens=6, grammar="gnum"),
+    dict(prompt=P[3], max_new_tokens=7, grammar="gab",
+         sampler=Sampler(temperature=1.2), adapter="a1", arrival_block=2),
+]
+
+
+def _serve(lm, ads, submits, fused):
+    eng = ServeEngine(lm, block_steps=K, rng=jax.random.key(42), fused=fused)
+    for name, spec in SPECS.items():
+        eng.register_grammar(name, **spec)
+    for name, ad in ads.items():
+        eng.register_adapter(name, ad, ACFG)
+    for kw in submits:
+        eng.submit(**kw)
+    eng.run()
+    return {c.request_id: c.tokens.tolist() for c in eng.completed}
+
+
+# ------------------------------------------------ the exactness matrix
+
+def test_tp2_streams_bit_identical_fused_and_stepwise():
+    """TP=2 paged + multi-LoRA + structured streams equal TP=1 token for
+    token, in BOTH decode modes — sharding the pools changes the layout,
+    not one sampled or masked token."""
+    lm1, ads1 = _stack(1)
+    ref = {f: _serve(lm1, ads1, SUBMITS, fused=f) for f in (True, False)}
+    assert ref[True] == ref[False]          # modes agree before TP enters
+    lm2, ads2 = _stack(2)
+    for fused in (True, False):
+        got = _serve(lm2, ads2, SUBMITS, fused=fused)
+        assert got == ref[fused], f"fused={fused}"
+
+
+def test_tp2_capacity_multiplication():
+    """The point of the shard: per-chip paged-pool bytes HALVE at TP=2
+    (×TP logical pages per chip-equivalent), the host/handoff page unit
+    stays global-width, and ~all pool bytes ride the sharded specs."""
+    # sizing consults the CURRENT world (the mesh the session would
+    # allocate under), so read each stack's numbers inside its own world
+    lm1, _ = _stack(1)
+    kv1 = lm1.kv_cache_bytes()
+    assert lm1.kv_page_bytes_host() == lm1.kv_page_bytes()
+    lm2, _ = _stack(2)
+    kv2 = lm2.kv_cache_bytes()
+    assert kv1["kv_bytes"] / kv2["kv_bytes"] >= 1.9
+    assert kv2["kv_bytes_global"] == kv1["kv_bytes"]
+    assert lm2.kv_page_bytes_host() == 2 * lm2.kv_page_bytes()
+    sess = lm2.start_session()
+    assert sharded_fraction(sess.cache) > 0.9
+
+
+def test_tp2_disagg_handoff_adopt_exact():
+    """The disagg seam under sharding: a TP=2 prefill/decode split serves
+    streams bit-identical to the TP=1 single-engine oracle — handoffs are
+    sealed at GLOBAL width (gather-at-seal), so every page adopts cleanly
+    into the adopter's sharded pool."""
+    submits = [dict(prompt=P[0], max_new_tokens=8),
+               dict(prompt=P[1], max_new_tokens=6, arrival_block=1,
+                    sampler=Sampler(temperature=1.1)),
+               dict(prompt=P[2], max_new_tokens=6, grammar="gnum",
+                    arrival_block=1)]
+    lm1, ads1 = _stack(1)
+    oracle = _serve(lm1, ads1, submits, fused=True)
+    lm2, _ = _stack(2)
+    router = DisaggRouter(lm2, 2, prefill_replicas=1,
+                          rng=jax.random.key(42), block_steps=K)
+    for name, spec in SPECS.items():
+        router.register_grammar(name, **spec)
+    for kw in submits:
+        router.submit(**kw)
+    router.run(max_blocks=300)
+    got = {c.request_id: c.tokens.tolist() for c in router.completed}
+    assert got == oracle
+    assert router.stats["handoffs_sent"] == len(submits)
+    assert router.stats["handoffs_adopted"] == len(submits)
+    assert router.stats["handoffs_degraded"] == 0
+
+
+# ------------------------------------------------ the spec layer itself
+
+def test_partition_spec_derivation():
+    """Name-keyed spec derivation: KV pools shard heads, row-parallel
+    LoRA A shards fan-in, column-parallel LoRA B shards fan-out, grammar
+    tables shard vocab, control leaves stay replicated — and any
+    non-divisible dim falls back to replicated, never to a wrong shard."""
+    from jax.sharding import PartitionSpec as PS
+
+    kv = leaf_partition_spec("['cache']['cached_key']", (2, 8, 4, 2, 8), 2)
+    assert kv == PS(None, None, None, "tp", None)
+    # non-divisible KV heads: replicated fallback
+    assert leaf_partition_spec(
+        "['cache']['cached_key']", (2, 8, 4, 3, 8), 2) == PS()
+    # row-parallel target shards A's fan-in; its B stays replicated
+    assert leaf_partition_spec(
+        "['lora_o_proj_a']", (2, 3, 32, 4), 2) == PS(None, None, "tp", None)
+    assert leaf_partition_spec("['lora_o_proj_b']", (2, 3, 4, 32), 2) == PS()
+    # column-parallel target shards B's fan-out; its A stays replicated
+    assert leaf_partition_spec(
+        "['lora_q_proj_b']", (2, 3, 4, 32), 2) == PS(None, None, None, "tp")
+    assert leaf_partition_spec("['lora_q_proj_a']", (2, 3, 32, 4), 2) == PS()
+    # grammar tables shard the vocab axis
+    assert leaf_partition_spec(
+        "['need']", (3, 48, 128), 2) == PS(None, None, "tp")
+    # control leaves replicated
+    assert leaf_partition_spec("['block_table']", (3, 16), 2) == PS()
+    # off-mesh the whole tree derives replicated
+    psm.destroy_model_parallel()
+    specs = serving_partition_specs(
+        {"cached_key": jnp.zeros((2, 8, 4, 2, 8)),
+         "need": jnp.zeros((3, 48, 128))})
+    assert all(s == PS() for s in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, PS)))

@@ -1865,6 +1865,14 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
                                 max_batch=max_batch,
                                 fused_steps=fused_steps))
 
+    # --- paged decode kernel + int8 KV pages (ISSUE 17 tentpole
+    # evidence): factored out as bench_paged_kernel() so
+    # scripts/bench_cpu_basis.py --kernel-update can refresh just these
+    # keys over a committed baseline.
+    out.update(bench_paged_kernel(lcfg, model.params, prompt_len=prompt_len,
+                                  max_batch=max_batch,
+                                  fused_steps=fused_steps))
+
     # --- TP-sharded serving (ISSUE 16 tentpole evidence): factored out as
     # bench_serving_tp() so scripts/bench_cpu_basis.py --tp-update can
     # refresh just these keys. NOTE: rebuilds its own params per TP world
@@ -2018,6 +2026,128 @@ def bench_structured(lcfg, params, prompt_len=128, max_batch=4,
         del lm_g, lm_gf, eng_g, _eng_f, gpool
     except Exception as e:  # noqa: BLE001 — structured section additive, never fatal
         out["serve_structured_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
+def bench_paged_kernel(lcfg, params, prompt_len=128, max_batch=4,
+                       fused_steps=16) -> dict:
+    """Paged flash-attention kernel + int8 KV pages (ISSUE 17 tentpole
+    evidence), a standalone function like :func:`bench_structured` so
+    ``scripts/bench_cpu_basis.py --kernel-update`` can refresh JUST these
+    keys over a committed artifact. Three claims:
+
+    * ``serve_tokens_per_sec_paged_kernel`` — end-to-end engine
+      throughput on the paged section's shared-prefix trace with the
+      block-sparse decode kernel in the scan (``paged_attn_kernel=True``:
+      decode reads the per-slot block table directly and never
+      materializes the (b, max_seq_len) gather). CPU basis runs the
+      kernel in Pallas interpret mode, so the absolute number is NOT the
+      perf claim there — the key exists so the TPU rounds have a gated
+      slot and the CPU rounds prove the path serves traffic end to end;
+    * ``paged_hbm_bytes_vs_slab_int8`` — int8 pool bytes (int8 K/V pools
+      + fp32 per-page scales) over the UN-quantized slab at the same
+      dims: the sizing claim, must stay <= 0.5;
+    * ``serve_greedy_match_rate_int8kv`` — token-for-token greedy stream
+      agreement of the int8-paged engine against the fp32 gather path on
+      the identical trace (zero-tolerance gate: quantization error must
+      not flip a single greedy token at these dims).
+
+    The fp32 KERNEL stream is checked bit-identical to the fp32 gather
+    stream inline (the exactness oracle) — any divergence raises and
+    lands in ``serve_paged_kernel_error`` rather than shipping a wrong
+    throughput number.
+
+    Takes the serving model's ``(lcfg, params)`` — it builds its own
+    paged pools, so any dims work (bench_serving passes 13B layer dims;
+    bench_cpu_basis tiny dims).
+    """
+    from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+    from neuronx_distributed_tpu.inference.engine import run_trace, synthetic_trace
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    out = {}
+    try:
+        page_size = 16
+        ppseq = (prompt_len + 256) // page_size
+        paged_kw = dict(buckets=(64, prompt_len), max_batch=max_batch,
+                        page_size=page_size,
+                        page_pool_pages=max_batch * ppseq // 2 + max_batch)
+        ktrace = synthetic_trace(
+            12, 32000, prompt_lens=(page_size,), max_new_tokens=48,
+            mean_interarrival_blocks=0.5,
+            shared_prefix_len=prompt_len - page_size, seed=0)
+
+        def krun(lm_):
+            # warm every insert program the trace can hit plus the fused
+            # block (bench_serving's paged discipline: compiles are
+            # process-global, so run ORDER would otherwise silently favor
+            # whichever variant ran last)
+            for rows in range(1, max_batch + 1):
+                for b in (64, prompt_len):
+                    lm_._paged_insert_programs(rows, b)
+            warm = ServeEngine(lm_, block_steps=fused_steps)
+            for item in ktrace[:max_batch]:
+                warm.submit(item["prompt"], 2)
+            warm.run()
+            eng_ = ServeEngine(lm_, block_steps=fused_steps)
+            rep_ = run_trace(eng_, ktrace)
+            streams = {c.request_id: c.tokens.tolist()
+                       for c in eng_.completed}
+            return rep_, streams
+
+        # fp32 gather path: the exactness reference AND the greedy oracle
+        # for the int8 match rate
+        lm_g = CausalLM(lcfg, params, LlamaForCausalLM, **paged_kw)
+        lm_g.compile()
+        _rep_g, streams_g = krun(lm_g)
+
+        # fp32 kernel path: the throughput claim; its streams must be
+        # BIT-identical to the gather's (same fp32 pool bytes, same
+        # tokens — only the attention schedule differs)
+        lm_k = CausalLM(lcfg, params, LlamaForCausalLM,
+                        paged_attn_kernel=True, **paged_kw)
+        lm_k.compile()
+        rep_k, streams_k = krun(lm_k)
+        if streams_k != streams_g:
+            raise AssertionError(
+                "fp32 kernel streams diverged from the gather oracle")
+        out["serve_tokens_per_sec_paged_kernel"] = rep_k["tokens_per_sec"]
+        out["serve_paged_kernel_host_ops_per_block"] = \
+            rep_k["host_ops_per_block"]
+
+        # int8 pages under the kernel: the sizing ratio (vs the
+        # UN-quantized slab — kv_cache_bytes pins the slab basis to
+        # config.dtype regardless of page_dtype) + greedy agreement
+        lm_i = CausalLM(lcfg, params, LlamaForCausalLM,
+                        paged_attn_kernel=True, page_dtype="int8",
+                        **paged_kw)
+        lm_i.compile()
+        kv_i = lm_i.kv_cache_bytes()
+        out["paged_hbm_bytes_int8"] = kv_i["kv_bytes"]
+        out["paged_hbm_bytes_vs_slab_int8"] = round(
+            kv_i["kv_bytes"] / kv_i["kv_slab_bytes"], 3)
+        _rep_i, streams_i = krun(lm_i)
+        tot = match = 0
+        for rid, ref in streams_g.items():
+            got = streams_i.get(rid, [])
+            tot += max(len(ref), len(got))
+            match += sum(1 for a, b_ in zip(ref, got) if a == b_)
+        out["serve_greedy_match_rate_int8kv"] = (
+            round(match / tot, 3) if tot else None)
+        out["serve_paged_kernel_basis"] = (
+            f"12 reqs @ 0.5 blocks sharing a {prompt_len - page_size}-"
+            f"token cached prefix ({page_size}-token suffix prompts, 48 "
+            f"new tokens, fused {fused_steps}-step blocks), page_size "
+            f"{page_size}, pool {max_batch * ppseq // 2 + max_batch} "
+            f"pages; kernel tok/s = block-sparse paged decode kernel "
+            f"(interpret mode on CPU — absolute number is basis-bound); "
+            f"int8 ratio = (int8 pools + fp32 per-page scales) / "
+            f"un-quantized slab at the same dims; match rate = greedy "
+            f"token agreement int8 vs fp32 gather, fp32 kernel checked "
+            f"bit-identical to gather inline")
+        del lm_g, lm_k, lm_i
+    except Exception as e:  # noqa: BLE001 — kernel section additive, never fatal
+        out["serve_paged_kernel_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 
@@ -2198,8 +2328,20 @@ HEADLINE_KEYS = (
     "serve_cold_ttft_ms", "serve_prefix_hit_ttft_ms",
     "serve_prefix_hit_ttft_ratio", "paged_hbm_bytes_vs_slab",
     "serve_tokens_per_sec_paged",
+    # paged flash-attention kernel + int8 KV pages (ISSUE 17): kernel-path
+    # throughput, the int8-vs-unquantized-slab sizing ratio (<= 0.5 gate)
+    # and the zero-tolerance greedy agreement of int8 streams vs the fp32
+    # gather oracle; absolute int8 pool bytes and the basis string ride
+    # the sidecar (2000-byte headline tail cap)
+    "serve_tokens_per_sec_paged_kernel", "paged_hbm_bytes_vs_slab_int8",
+    "serve_greedy_match_rate_int8kv",
     "serve_prefix_hit_ttft_ms_tiered", "tier_restore_ms_p99",
-    "serve_shed_rate_poolpressure", "serve_shed_rate_poolpressure_tiered",
+    # serve_shed_rate_poolpressure and serve_deadline_miss_rate_noshed
+    # (the no-mitigation contrast bases — the tiered shed rate and the
+    # shedding miss rate they contrast against both still gate) moved to
+    # the sidecar in ISSUE 17 to make room for the paged-kernel keys
+    # under the 2000-byte tail cap
+    "serve_shed_rate_poolpressure_tiered",
     # serve_itl_p99_ms_unchunked (one-shot-insert contrast basis):
     # sidecar-only since ISSUE 14 (headline size cap)
     "serve_itl_p50_ms", "serve_itl_p99_ms",
@@ -2211,7 +2353,7 @@ HEADLINE_KEYS = (
     "serve_decode_stall_ms_longprompt_chunked",
     "serve_itl_p99_ms_disagg", "serve_decode_stall_ms_longprompt_disagg",
     "serve_goodput_2x_overload", "serve_goodput_2x_vs_1x",
-    "serve_deadline_miss_rate_shed", "serve_deadline_miss_rate_noshed",
+    "serve_deadline_miss_rate_shed",
     "serve_recovery_replay_ms", "serve_tracing_overhead_ratio",
     "serve_agg_goodput_2x_n4",
     "serve_tenant_p99_fairness_ratio", "serve_failover_replay_ms",
@@ -2239,7 +2381,7 @@ HEADLINE_KEYS = (
     "serve_chunked_error", "serve_overload_error", "serve_router_error",
     "serve_tier_error", "serve_multilora_error", "serve_disagg_error",
     "serve_autoscale_error", "serve_structured_error", "sched_soak_error",
-    "serve_tp2_error",
+    "serve_tp2_error", "serve_paged_kernel_error",
 )
 
 

@@ -143,13 +143,23 @@ def build_model(args):
 
         params = quantize_params(params)
     paged_kw = {}
+    # storage/kernel knobs imply paged mode — `serve --paged-kernel` or
+    # `serve --kv_dtype int8` alone gets the page pool they require
+    if getattr(args, "paged_kernel", False) or getattr(args, "kv_dtype", None):
+        if args.cmd != "serve":
+            raise SystemExit("--paged-kernel/--kv_dtype apply to the serve "
+                             "subcommand only")
+        args.paged = True
     if getattr(args, "paged", False):
         if args.cmd != "serve":
             raise SystemExit("--paged applies to the serve subcommand only "
                              "(generate/benchmark run the contiguous path)")
         paged_kw = dict(page_size=args.page_size,
                         page_pool_pages=args.page_pool_pages or None,
-                        prefix_cache=not args.no_prefix_cache)
+                        prefix_cache=not args.no_prefix_cache,
+                        page_dtype=getattr(args, "kv_dtype", None),
+                        paged_attn_kernel=getattr(args, "paged_kernel",
+                                                  False))
     if getattr(args, "adapters", 0) > 0:
         # multi-LoRA serving pool: N demo adapters share this one base
         # model via per-slot batched low-rank corrections (S-LoRA); the
@@ -881,6 +891,20 @@ def main(argv=None) -> None:
                        help="serve --paged: per-layer pool size in pages "
                             "(0 = slab parity; smaller = the HBM win, "
                             "admission defers under pool pressure)")
+        p.add_argument("--paged-kernel", dest="paged_kernel",
+                       action="store_true",
+                       help="serve: fused paged decode-attention kernel "
+                            "(Pallas; interpret mode off-TPU) — decode "
+                            "steps attend straight off the page pool "
+                            "through the block tables, no logical-slab "
+                            "gather. Implies --paged.")
+        p.add_argument("--kv_dtype", choices=["float32", "int8"],
+                       default=None,
+                       help="serve: KV page storage dtype. int8 stores "
+                            "pages quantized (absmax per page x kv-head) "
+                            "with per-page fp32 scales — ~4x fewer pool "
+                            "bytes, bounded-divergence numerics. Implies "
+                            "--paged.")
         p.add_argument("--no_prefix_cache", action="store_true",
                        help="serve --paged: disable the radix prefix index "
                             "(pages still pooled, no cross-request sharing)")

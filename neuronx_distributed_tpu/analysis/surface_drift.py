@@ -20,6 +20,14 @@ sub-check below is one edge of that graph:
   grammar_* / tier_*`` — the bench_cpu_basis coverage) is present in its
   parsed report: a serving key absent from every committed baseline
   compares as ``new_key`` forever and is effectively ungated.
+* ``headline-producer``: every SERVING-basis headline key is actually
+  PRODUCED by bench.py — a literal ``out["key"] = ...`` store (or an
+  f-string store whose literal head prefixes the key) somewhere outside
+  the HEADLINE_KEYS declaration itself. A key that is declared and
+  carried by the baseline but that no section writes anymore gates
+  forever on a fossilized number (the regress compare sees
+  old-vs-missing as ``removed_key``, but only after the NEXT refresh —
+  this catches the rename at the commit that makes it).
 * ``faultplan``: every ``FaultPlan`` ``*_prob`` field is referenced by
   an injector call site in the package (outside faults.py) and
   mentioned in at least one test.
@@ -107,6 +115,37 @@ def _check_bench_surface(ctx: RepoCtx) -> Iterator[Finding]:
                 "surface-drift", bench.rel, 1, "HEADLINE_KEYS",
                 f"headline key '{key}' matches no bench_regress RULES "
                 f"pattern — it reports as 'info' and never gates")
+    # headline-producer: a serving headline key must have a producing
+    # store in bench.py. HEADLINE_KEYS itself is a tuple of constants —
+    # never a Subscript store — so the declaration can't self-satisfy.
+    produced: Set[str] = set()
+    produced_prefixes: List[str] = []
+    for node in ast.walk(bench.tree):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Store)):
+            if (isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                produced.add(node.slice.value)
+            elif (isinstance(node.slice, ast.JoinedStr)
+                    and node.slice.values):
+                head = node.slice.values[0]
+                if (isinstance(head, ast.Constant)
+                        and isinstance(head.value, str) and head.value):
+                    produced_prefixes.append(head.value)
+    for key in headline:
+        key = str(key)
+        if NONNUMERIC_KEY.search(key) or not SERVING_KEY.match(key):
+            continue
+        if key in produced:
+            continue
+        if any(key.startswith(p) for p in produced_prefixes):
+            continue
+        yield Finding(
+            "surface-drift", bench.rel, 1, "HEADLINE_KEYS",
+            f"serving headline key '{key}' has no producing store in "
+            f"bench.py (no literal out['{key}'] = ... outside the "
+            f"HEADLINE_KEYS declaration) — it gates forever on the "
+            f"baseline's fossilized value")
     art = _newest_artifact(ctx.root)
     if art is None:
         return

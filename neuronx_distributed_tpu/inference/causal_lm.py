@@ -203,6 +203,8 @@ class CausalLM:
         param_transform=None,
         page_size: Optional[int] = None,
         page_pool_pages: Optional[int] = None,
+        page_dtype: Optional[str] = None,
+        paged_attn_kernel: bool = False,
         prefix_cache: bool = True,
         lora_rank: Optional[int] = None,
         lora_slots: int = 0,
@@ -230,9 +232,23 @@ class CausalLM:
                     f"{self.config.max_seq_len}")
             pool = page_pool_pages or (
                 max_batch * (self.config.max_seq_len // page_size) + max_batch)
-            self.config = dataclasses.replace(
-                self.config, page_size=int(page_size),
-                page_pool_pages=int(pool))
+            over = dict(page_size=int(page_size), page_pool_pages=int(pool))
+            # int8 page storage + the fused decode kernel are paged-mode
+            # knobs; replace() only when set so non-Llama configs without
+            # the fields keep working un-paged.
+            if page_dtype is not None:
+                if page_dtype not in ("int8", "float32"):
+                    raise ValueError(
+                        f"page_dtype must be 'int8' or 'float32', "
+                        f"got {page_dtype!r}")
+                over["page_dtype"] = page_dtype
+            if paged_attn_kernel:
+                over["paged_attn_kernel"] = True
+            self.config = dataclasses.replace(self.config, **over)
+        elif page_dtype or paged_attn_kernel:
+            raise ValueError(
+                "page_dtype / paged_attn_kernel require paged mode "
+                "(pass page_size)")
         # multi-LoRA serving (inference/adapters.py): the config grows the
         # pool dims so every targeted projection declares its per-slot A/B
         # stacks; each session then owns an AdapterPool whose tree rides
@@ -789,25 +805,40 @@ class CausalLM:
         payloads and host-tier pages gather to full width);
         ``kv_slab_bytes`` is the per-chip slab-equivalent for the same
         dims — the memory-sizing formula the README documents (paged/slab
-        = page_pool_pages*page_size / (max_batch*max_seq_len))."""
+        = page_pool_pages*page_size / (max_batch*max_seq_len)).
+
+        Dtype-aware: every count is derived from each leaf's OWN dtype,
+        so ``page_dtype="int8"`` pools report ~1/4 the fp32 bytes (plus
+        the fp32 scale leaves, which are counted in actual/global but
+        contribute nothing to the slab equivalent — the slab baseline is
+        always the un-quantized ``config.dtype`` slab, which is what the
+        int8 pool is competing against for HBM)."""
         from neuronx_distributed_tpu.parallel import mesh as ps
 
         tp = (ps.get_tensor_model_parallel_size()
               if ps.model_parallel_is_initialized() else 1)
+        pool_leaves = ("['cached_key']", "['cached_value']")
+        scale_leaves = ("['cached_key_scale']", "['cached_value_scale']")
+        slab_itemsize = jnp.dtype(self.config.dtype).itemsize
         actual = actual_global = slab = 0
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self._cache_avals())[0]:
             p = jax.tree_util.keystr(path)
-            if not (p.endswith("['cached_key']") or p.endswith("['cached_value']")):
+            is_pool = p.endswith(pool_leaves)
+            if not (is_pool or p.endswith(scale_leaves)):
                 continue
             nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
             spec = leaf_partition_spec(p, leaf.shape, tp)
             shard_div = tp if any(ax is not None for ax in spec) else 1
             actual += nbytes // shard_div
             actual_global += nbytes
+            if not is_pool:
+                continue  # scale leaves have no slab counterpart
             if self.paged:
                 tokens = self.config.page_pool_pages * self.config.page_size
-                slab += (nbytes // shard_div) * (
+                slab_nbytes = (int(np.prod(leaf.shape)) * slab_itemsize
+                               // shard_div)
+                slab += slab_nbytes * (
                     self.max_batch * self.config.max_seq_len) // tokens
             else:
                 slab += nbytes // shard_div

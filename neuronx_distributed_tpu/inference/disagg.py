@@ -106,6 +106,13 @@ class KVHandoff:
     # exists so an adopter on a DIFFERENT degree rejects structurally
     # (degrade-to-re-prefill) instead of trusting framing it can't check.
     tp_degree: int = 1
+    # storage dtype of the SEALING worker's page pool ("float32"/
+    # "bfloat16"/"int8"; int8 payloads carry the quantized pages PLUS
+    # their fp32 scale leaves). Same contract as ``tp_degree``: an
+    # adopter whose pool dtype differs cannot write these bytes — it
+    # degrades to a local re-prefill rather than rescale/re-quantize KV
+    # mid-stream (a silent numerics fork the exactness oracle forbids).
+    page_dtype: str = "float32"
 
     def seal(self) -> "KVHandoff":
         self.crcs = [HostPageTier._crc(p) for p in self.payloads]

@@ -281,6 +281,16 @@ class _PrefillInFlight:
 # ``engine.stats`` dict carried, now backed by MetricsRegistry counters
 # (exposition name ``serve_<key>``) through the dict-compatible view below —
 # the parity test in tests/test_observability.py pins this list
+# every cache leaf whose axis 1 is the physical PAGE axis — what the
+# page-IO closures (tier spill/restore, handoff framing, corruption
+# seam) move per page. int8 pools add the per-(page, head) fp32 scale
+# leaves; a page's bytes and its scales always travel (and garble, and
+# CRC) together.
+_KV_PAGE_LEAVES = (
+    "['cached_key']", "['cached_value']",
+    "['cached_key_scale']", "['cached_value_scale']",
+)
+
 _STAT_KEYS = (
     "blocks", "decode_blocks", "inserts", "inserted_requests",
     "program_calls", "host_fetches", "deferred_admissions",
@@ -2032,6 +2042,17 @@ class ServeEngine:
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
 
+    def _page_dtype(self) -> str:
+        """This engine's resolved page-pool storage dtype as a string —
+        the handoff framing stamp ("int8" pools, else the config compute
+        dtype the pool leaves are allocated in)."""
+        cfg = self.lm.config
+        pd = getattr(cfg, "page_dtype", None)
+        if pd == "int8":
+            return "int8"
+        # sim-mode configs (inference/simlm.py) carry no compute dtype
+        return str(jnp.dtype(pd or getattr(cfg, "dtype", None) or jnp.float32))
+
     def _read_page_bytes(self, page: int) -> Dict[str, np.ndarray]:
         """Host copy of one physical page's K/V bytes across every layer —
         the tier's spill read ({cache-leaf path: (L, page_size, kv, hd)
@@ -2040,8 +2061,7 @@ class ServeEngine:
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self.session.cache)[0]:
             p = jax.tree_util.keystr(path)
-            if (p.endswith("['cached_key']")
-                    or p.endswith("['cached_value']")):
+            if p.endswith(_KV_PAGE_LEAVES):
                 out[p] = np.asarray(leaf[:, int(page)])
         return out
 
@@ -2087,8 +2107,7 @@ class ServeEngine:
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self.session.cache)[0]:
             p = jax.tree_util.keystr(path)
-            if (p.endswith("['cached_key']")
-                    or p.endswith("['cached_value']")):
+            if p.endswith(_KV_PAGE_LEAVES):
                 arr = np.asarray(leaf[:, idx])       # (L, n_pad, page, kv, hd)
                 for i in range(len(pages)):
                     out[i][p] = arr[:, i]
@@ -2123,10 +2142,12 @@ class ServeEngine:
         to rewrite the data, not merely re-point block tables."""
         def fix(path, leaf):
             p = jax.tree_util.keystr(path)
-            if (p.endswith("['cached_key']")
-                    or p.endswith("['cached_value']")):
+            if p.endswith(_KV_PAGE_LEAVES):
                 for pg in pages:
-                    leaf = leaf.at[:, pg].set(jnp.asarray(104729.0, leaf.dtype))
+                    # astype (not dtype=) so int8 pools garble by wrap
+                    # instead of raising on the unsafe cast
+                    leaf = leaf.at[:, pg].set(
+                        jnp.asarray(104729.0).astype(leaf.dtype))
             return leaf
 
         from neuronx_distributed_tpu.inference.partition import repin
@@ -2256,7 +2277,8 @@ class ServeEngine:
             ts_list = self._out_ts.get(rid) or [time.perf_counter()]
             h = KVHandoff(req=req, first_token=first,
                           first_ts=float(ts_list[0]), page_size=ps,
-                          payloads=payloads, tp_degree=tp)
+                          payloads=payloads, tp_degree=tp,
+                          page_dtype=self._page_dtype())
             h.seal()
             self.outbox.append(h)
             self.stats["handoffs_sent"] += 1
@@ -2329,6 +2351,21 @@ class ServeEngine:
                     args={"rid": req.request_id,
                           "src_tp": int(getattr(h, "tp_degree", 1)),
                           "dst_tp": int(my_tp)})
+            return "degraded"
+        my_pd = self._page_dtype()
+        if getattr(h, "page_dtype", "float32") != my_pd:
+            # foreign page dtype: the payload bytes are in a storage
+            # format this pool cannot hold (and re-quantizing mid-stream
+            # would fork the numerics) — degrade to local re-prefill,
+            # exactly the tp_degree-mismatch discipline
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "migrate:page_dtype_mismatch", (self.lane, "migrate"),
+                    block=self.blocks,
+                    args={"rid": req.request_id,
+                          "src_dtype": str(getattr(h, "page_dtype",
+                                                   "float32")),
+                          "dst_dtype": my_pd})
             return "degraded"
         if not h.verify():
             if self.tracer.enabled:
@@ -3564,6 +3601,11 @@ def run_trace(engine: ServeEngine, trace: List[dict],
             "paged": True,
             "page_size": pkv.page_size,
             "page_pool_pages": pkv.num_pages,
+            # storage + kernel knobs (ISSUE 17): what the pool bytes
+            # below were measured under
+            "page_dtype": engine._page_dtype(),
+            "paged_attn_kernel": bool(
+                getattr(engine.lm.config, "paged_attn_kernel", False)),
             "prefix_queries": pkv.stats["prefix_queries"],
             "prefix_hits": pkv.stats["prefix_hits"],
             "prefix_hit_tokens": pkv.stats["prefix_hit_tokens"],

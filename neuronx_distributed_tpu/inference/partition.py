@@ -19,7 +19,10 @@ The sharding story, per collection:
   over ``tp``, matching the GQA QKV projection's head split. Attention
   gathers index the PAGE axis, so every gather stays local per shard;
   one logical page id maps to one slice per shard and the host-side
-  ``PageAllocator``/``RadixPrefixIndex`` stay shard-agnostic.
+  ``PageAllocator``/``RadixPrefixIndex`` stay shard-agnostic. int8
+  pools' per-(page, kv-head) fp32 scale leaves
+  (``cached_key_scale``/``cached_value_scale``) follow the same -2-axis
+  rule, so a page's bytes and its scales never cross a chip boundary.
 * **Adapter stacks** (``lora_<target>_{a,b}``): column-parallel targets
   (q/k/v/gate/up) shard the B fan-out (the base kernel's output split;
   A replicated); row-parallel targets (o_proj/down_proj) shard the A
@@ -83,7 +86,12 @@ def leaf_partition_spec(path: str, shape, tp: int) -> PartitionSpec:
     ``jax.tree_util.keystr`` suffix or a bare ``['name']``). Replicated
     whenever the would-be sharded dim does not divide ``tp``."""
     nd = len(shape)
-    if path.endswith("['cached_key']") or path.endswith("['cached_value']"):
+    if path.endswith(("['cached_key']", "['cached_value']",
+                      "['cached_key_scale']", "['cached_value_scale']")):
+        # int8 pools carry per-(page, kv-head) fp32 scale leaves shaped
+        # (.., npages, 1, n_kv, 1): the n_kv axis sits at -2 exactly like
+        # the pools, so one rule shards pool and scales congruently — a
+        # shard's pages and their scales always live on the same chip.
         if nd >= 2 and _shardable(shape[-2], tp):
             return PartitionSpec(*([None] * (nd - 2)), "tp", None)
         return PartitionSpec()

@@ -103,6 +103,21 @@ class LlamaConfig:
     # (that shape equality is what makes paged attention bit-identical).
     page_size: Optional[int] = None
     page_pool_pages: Optional[int] = None
+    # paged-pool storage dtype (paged mode only). None = ``dtype``;
+    # "int8" stores K/V pages quantized (absmax per page x kv-head, the
+    # quantization/core.py convention lifted from weights to KV) with
+    # per-(page, head) fp32 scales as sibling cache leaves
+    # (``cached_key_scale``/``cached_value_scale``) — ~4x fewer pool
+    # bytes than fp32 pages at the same page count, dequantized at the
+    # attention read (inside the kernel tile on the kernel path).
+    page_dtype: Optional[str] = None
+    # fused paged decode attention (inference/paged_kernel.py): the
+    # single-token decode step attends straight off the page pool through
+    # the block tables (block-sparse flash tiling) instead of gathering
+    # the (b, max_seq_len) logical slab in-scan. Prefill/chunk widths and
+    # Medusa tree steps keep the gather path — which also stays, at fp32
+    # pages, the bit-exactness reference oracle for this branch.
+    paged_attn_kernel: bool = False
     # multi-LoRA serving pool (inference/adapters.py, S-LoRA/Punica): every
     # targeted projection gains per-slot low-rank stacks A (lora_slots,
     # fan_in, lora_rank) / B (lora_slots, lora_rank, fan_out) + scale on a
@@ -423,12 +438,27 @@ class LlamaAttention(nn.Module):
             # scan carries them as loop-invariant state (in-scan gather).
             npages = cfg.page_pool_pages
             ppseq = cfg.max_seq_len // ps
+            quantized = cfg.page_dtype == "int8"
+            pool_dtype = (jnp.int8 if quantized
+                          else jnp.dtype(cfg.page_dtype or cfg.dtype))
             ck = self.variable("cache", "cached_key",
-                               jnp.zeros, (npages, ps, n_kv, hd), cfg.dtype)
+                               jnp.zeros, (npages, ps, n_kv, hd), pool_dtype)
             cv = self.variable("cache", "cached_value",
-                               jnp.zeros, (npages, ps, n_kv, hd), cfg.dtype)
+                               jnp.zeros, (npages, ps, n_kv, hd), pool_dtype)
             bt = self.variable("cache", "block_table",
                                lambda: jnp.zeros((b, ppseq), jnp.int32))
+            cks = cvs = None
+            if quantized:
+                # per-(page, kv-head) fp32 absmax scales as SIBLING pool
+                # leaves: n_kv at axis -2 like the pools themselves, so
+                # the whole cache-collection plumbing (partition specs,
+                # page-IO framing, handoff CRCs, donation) extends to
+                # them without special cases. All-zero init dequantizes
+                # unwritten pages to exact zeros, same as the fp pool.
+                cks = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                    (npages, 1, n_kv, 1), jnp.float32)
+                cvs = self.variable("cache", "cached_value_scale", jnp.zeros,
+                                    (npages, 1, n_kv, 1), jnp.float32)
         else:
             ck = self.variable("cache", "cached_key",
                                jnp.zeros, (b, cfg.max_seq_len, n_kv, hd), cfg.dtype)
@@ -474,35 +504,84 @@ class LlamaAttention(nn.Module):
             # Writes at slots >= max_seq_len are DROPPED, matching the slab
             # path's out-of-bounds scatter (the overflow latch freezes a row
             # instead of letting its writes wrap onto a neighbour).
-            table = bt.value                                       # (b, ppseq)
-            page_of = jnp.clip(slots // ps, 0, ppseq - 1)
-            phys = jnp.take_along_axis(table, page_of, axis=1)     # (b, s_new)
-            flat = jnp.where(slots < cfg.max_seq_len,
-                             phys * ps + slots % ps, npages * ps)
-            kf = ck.value.reshape(npages * ps, n_kv, hd)
-            vf = cv.value.reshape(npages * ps, n_kv, hd)
-            kf = kf.at[flat].set(k.astype(kf.dtype), mode="drop")
-            vf = vf.at[flat].set(v.astype(vf.dtype), mode="drop")
-            # pin the pool's serving spec at the write (n_kv over 'tp'
-            # under a mesh, no-op otherwise): page-axis scatters/gathers
-            # never cross the head shard, so the whole paged hot path
-            # stays local per shard (inference/partition.py)
             from neuronx_distributed_tpu.inference.partition import (
                 constrain_named,
             )
 
-            ck.value = constrain_named(
-                "cached_key", kf.reshape(npages, ps, n_kv, hd))
-            cv.value = constrain_named(
-                "cached_value", vf.reshape(npages, ps, n_kv, hd))
-            # in-scan gather: the (b, max_seq_len) logical view the attention
-            # below consumes. Stale bytes in reused pages sit behind the
-            # position mask exactly like the slab's unwritten zeros (masked
-            # scores are -1e30 -> exactly-zero probs), so attention over the
-            # view is bit-identical to the contiguous path.
-            lpos = jnp.arange(cfg.max_seq_len)
-            all_flat = table[:, lpos // ps] * ps + (lpos % ps)[None, :]
-            k_all, v_all = kf[all_flat], vf[all_flat]
+            table = bt.value                                       # (b, ppseq)
+            if quantized:
+                # int8 pages: dequant-modify-requant over the W-page
+                # window this step touches (the narrowest logical span
+                # covering slots idx..idx+s_new-1 at any alignment).
+                # Absmax is a PAGE property, so inserting even one token
+                # re-derives the whole page's scale from its fp values.
+                W = (s_new + ps - 1) // ps + 1
+                first = idx // ps                                  # (b,)
+                lpage = (first[:, None]
+                         + jnp.arange(W, dtype=jnp.int32)[None, :])  # (b, W)
+                from neuronx_distributed_tpu.inference.paged_kernel import (
+                    dequantize_kv_pages,
+                    quantize_kv_pages,
+                )
+
+                phys_w = jnp.take_along_axis(
+                    table, jnp.clip(lpage, 0, ppseq - 1), axis=1)  # (b, W)
+                kw = dequantize_kv_pages(ck.value[phys_w], cks.value[phys_w])
+                vw = dequantize_kv_pages(cv.value[phys_w], cvs.value[phys_w])
+                kw = kw.reshape(b, W * ps, n_kv, hd)
+                vw = vw.reshape(b, W * ps, n_kv, hd)
+                # window-relative slots; >= max_seq_len drops like the fp
+                # scatter (overflow latch / chunk pad tails past the end)
+                rel = jnp.where(slots < cfg.max_seq_len,
+                                slots - first[:, None] * ps, W * ps)
+                kw = kw.at[rows, rel].set(k.astype(jnp.float32), mode="drop")
+                vw = vw.at[rows, rel].set(v.astype(jnp.float32), mode="drop")
+                # zero positions at/above the row's new length: stale
+                # bytes in a reused page are behind the mask for READS,
+                # but here they would inflate the fresh absmax scale
+                wpos = (first[:, None] * ps
+                        + jnp.arange(W * ps, dtype=jnp.int32)[None, :])
+                live = (wpos < (idx + s_new)[:, None])[..., None, None]
+                kw = jnp.where(live, kw, 0.0).reshape(b, W, ps, n_kv, hd)
+                vw = jnp.where(live, vw, 0.0).reshape(b, W, ps, n_kv, hd)
+                # requantize: absmax per (page, kv head)
+                kq, k_sc = quantize_kv_pages(kw)
+                vq, v_sc = quantize_kv_pages(vw)
+                # write back ONLY pages this step actually touched: an
+                # untouched window page maps through table entries that
+                # may still be 0 — i.e. ANOTHER row's live physical page
+                # — so a blind window write-back would corrupt it.
+                last = jnp.minimum(idx + s_new - 1, cfg.max_seq_len - 1) // ps
+                touched = (lpage <= last[:, None]) & (lpage < ppseq)
+                dest = jnp.where(touched, phys_w, npages)          # (b, W)
+                ck.value = constrain_named(
+                    "cached_key", ck.value.at[dest].set(kq, mode="drop"))
+                cv.value = constrain_named(
+                    "cached_value", cv.value.at[dest].set(vq, mode="drop"))
+                cks.value = constrain_named(
+                    "cached_key_scale",
+                    cks.value.at[dest].set(k_sc, mode="drop"))
+                cvs.value = constrain_named(
+                    "cached_value_scale",
+                    cvs.value.at[dest].set(v_sc, mode="drop"))
+            else:
+                page_of = jnp.clip(slots // ps, 0, ppseq - 1)
+                phys = jnp.take_along_axis(table, page_of, axis=1)  # (b, s_new)
+                flat = jnp.where(slots < cfg.max_seq_len,
+                                 phys * ps + slots % ps, npages * ps)
+                kf = ck.value.reshape(npages * ps, n_kv, hd)
+                vf = cv.value.reshape(npages * ps, n_kv, hd)
+                kf = kf.at[flat].set(k.astype(kf.dtype), mode="drop")
+                vf = vf.at[flat].set(v.astype(vf.dtype), mode="drop")
+                # pin the pool's serving spec at the write (n_kv over 'tp'
+                # under a mesh, no-op otherwise): page-axis scatters/gathers
+                # never cross the head shard, so the whole paged hot path
+                # stays local per shard (inference/partition.py)
+                ck.value = constrain_named(
+                    "cached_key", kf.reshape(npages, ps, n_kv, hd))
+                cv.value = constrain_named(
+                    "cached_value", vf.reshape(npages, ps, n_kv, hd))
+            k_all = v_all = None  # gather deferred: the kernel may skip it
         else:
             # mode="drop" pins the out-of-bounds semantics the overflow
             # latch and late chunked-prefill extends rely on (a chunk whose
@@ -523,6 +602,44 @@ class LlamaAttention(nn.Module):
                     v.astype(cv.value.dtype), mode="drop"))
             k_all, v_all = ck.value, cv.value
         ci.value = idx + s_new
+        if ps:
+            from neuronx_distributed_tpu.inference.paged_kernel import (
+                paged_decode_attention,
+                paged_kernel_supported,
+            )
+
+            if (cfg.paged_attn_kernel and chunk_mask is None
+                    and paged_kernel_supported(s_new, ps, q.shape[2], n_kv)):
+                # fused paged decode (inference/paged_kernel.py): attend
+                # straight off the POST-write pool through the block
+                # table — no logical slab is ever materialized, which is
+                # the whole perf point of this branch. The gather below
+                # stays as the bit-exactness reference oracle.
+                o = paged_decode_attention(
+                    q, ck.value, cv.value, table, idx,
+                    k_scale=cks.value if quantized else None,
+                    v_scale=cvs.value if quantized else None)
+                return self._o_proj(o.reshape(b, s_new, -1), aidx)
+            # in-scan gather: the (b, max_seq_len) logical view the
+            # attention below consumes. Stale bytes in reused pages sit
+            # behind the position mask exactly like the slab's unwritten
+            # zeros (masked scores are -1e30 -> exactly-zero probs), so
+            # attention over the view is bit-identical to the contiguous
+            # path.
+            lpos = jnp.arange(cfg.max_seq_len)
+            pg = table[:, lpos // ps]                         # (b, S)
+            all_flat = pg * ps + (lpos % ps)[None, :]
+            kf = ck.value.reshape(npages * ps, n_kv, hd)
+            vf = cv.value.reshape(npages * ps, n_kv, hd)
+            k_all, v_all = kf[all_flat], vf[all_flat]
+            if quantized:
+                # dequantize the logical view with each slot's page scale
+                ks2 = cks.value.reshape(npages, n_kv)[pg]     # (b, S, n_kv)
+                vs2 = cvs.value.reshape(npages, n_kv)[pg]
+                k_all = (k_all.astype(jnp.float32)
+                         * ks2[..., None]).astype(cfg.dtype)
+                v_all = (v_all.astype(jnp.float32)
+                         * vs2[..., None]).astype(cfg.dtype)
         if chunk_mask is not None:
             # prefix slots (< idx) fully visible; chunk slots by tree mask
             s_max = cfg.max_seq_len

@@ -50,6 +50,15 @@ tiny dims:
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
         --tp-update BENCH_r08.json BENCH_r09.json
+
+Paged-kernel + int8-KV refresh (ISSUE 17): the three kernel HEADLINE
+keys (``serve_tokens_per_sec_paged_kernel``,
+``paged_hbm_bytes_vs_slab_int8``, ``serve_greedy_match_rate_int8kv``)
+predate every committed artifact, so ``--kernel-update`` builds one
+tiny-dims model and re-measures just ``bench.bench_paged_kernel``:
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --kernel-update BENCH_r09.json BENCH_r10.json
 """
 
 from __future__ import annotations
@@ -161,6 +170,70 @@ def _structured_update(base_path: str, out_path: str) -> int:
     return 0
 
 
+def _kernel_update(base_path: str, out_path: str) -> int:
+    """BENCH_r0(x+1) = BENCH_r0x + freshly measured paged-kernel/int8-KV
+    keys (ISSUE 17: the kernel and int8 page pools postdate every
+    committed serving artifact — without this refresh bench_regress
+    would report the three new HEADLINE keys as new_key forever and the
+    zero-tolerance greedy-agreement gate would never arm). Builds ONE
+    tiny-dims model and runs just bench.bench_paged_kernel over it — the
+    same CPU basis (and the same dims) as the carried-over sections."""
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models.llama import (LlamaConfig,
+                                                      LlamaForCausalLM)
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, neuronx_distributed_config,
+    )
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+
+    prompt_len, max_batch = 128, 4
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    cfg = neuronx_distributed_config(tensor_parallel_size=1)
+    lcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=prompt_len + 256, dtype=jnp.float32,
+        param_dtype=jnp.float32, use_flash_attention=False,
+        remat_policy=None)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg),
+                                      ids)
+    kernel = bench.bench_paged_kernel(lcfg, model.params,
+                                      prompt_len=prompt_len,
+                                      max_batch=max_batch, fused_steps=16)
+    parsed.update(kernel)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | paged-kernel/int8-KV keys measured by --kernel-update "
+        + "(Pallas interpret mode on CPU) on top of " + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--kernel-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in kernel if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _tp_update(base_path: str, out_path: str) -> int:
     """BENCH_r0(x+1) = BENCH_r0x + freshly measured TP-sharded-serving
     keys (ISSUE 16: the keys need >= 2 devices, which no committed
@@ -227,6 +300,8 @@ def main() -> int:
         return _structured_update(sys.argv[2], sys.argv[3])
     if len(sys.argv) >= 4 and sys.argv[1] == "--tp-update":
         return _tp_update(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 4 and sys.argv[1] == "--kernel-update":
+        return _kernel_update(sys.argv[2], sys.argv[3])
 
     import jax.numpy as jnp
 

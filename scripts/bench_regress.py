@@ -101,6 +101,15 @@ RULES: List[Tuple[str, str, float]] = [
     # sharding regression moves it, so it gates tight
     (r"serve_tp2_vs_tp1", "higher", 0.25),
     (r"serve_kv_pool_capacity_x_tp", "higher", 0.03),
+    # paged decode kernel + int8 KV pages (ISSUE 17): kernel tok/s gates
+    # like every throughput key; the int8-pool-vs-unquantized-slab sizing
+    # ratio is DETERMINISTIC at fixed dims (only a layout regression
+    # moves it); the int8 greedy agreement vs the fp32 gather oracle is
+    # zero-tolerance like serve_structured_parse_rate — quantization
+    # error must never start flipping greedy tokens at the bench dims
+    (r"serve_tokens_per_sec_paged_kernel", "higher", 0.10),
+    (r"paged_hbm_bytes_vs_slab_int8", "lower", 0.10),
+    (r"serve_greedy_match_rate_int8kv", "higher", 0.0),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better

@@ -1,6 +1,7 @@
 """Known-bad fixture bench surface: ``ghost_ratio`` matches no regress
-rule (silently ungated) and ``serve_thing_ms`` is declared but absent
-from the committed artifact."""
+rule (silently ungated), ``serve_thing_ms`` is declared but absent
+from the committed artifact, and no serving key has a producing store
+(the headline-producer sub-check fires on both)."""
 
 HEADLINE_KEYS = (
     "ghost_ratio",

@@ -1,5 +1,5 @@
 """Known-good fixture bench surface: every gating key has a regress
-rule and appears in the committed artifact."""
+rule, appears in the committed artifact, and has a producing store."""
 
 HEADLINE_KEYS = (
     "serve_thing_ms",
@@ -7,3 +7,11 @@ HEADLINE_KEYS = (
     "good_ratio",
     "bench_error",
 )
+
+
+def bench_serving():
+    out = {}
+    out["serve_thing_ms"] = 1.0
+    out["serve_present_ms"] = 2.0
+    out["good_ratio"] = 1.0
+    return out

@@ -98,6 +98,12 @@ def _run_main(monkeypatch, capsys, tmp_path, times, skipped=()):
                                       "serve_itl_p50_ms_freeform": 6.28,
                                       "serve_structured_requests": 6,
                                       "grammar_bytes_per_slot": 15360000,
+                                      "serve_tokens_per_sec_paged_kernel": 455.0,
+                                      "paged_hbm_bytes_vs_slab_int8": 0.14,
+                                      "serve_greedy_match_rate_int8kv": 1.0,
+                                      "paged_hbm_bytes_int8": 429312,
+                                      "serve_paged_kernel_host_ops_per_block": 2.0,
+                                      "serve_paged_kernel_basis": "12 reqs",
                                       "serve_tokens_per_sec_tp1": 500.0,
                                       "serve_tokens_per_sec_tp2": 905.0,
                                       "serve_tp2_vs_tp1": 1.81,
@@ -197,16 +203,22 @@ def test_report_r5_shape(monkeypatch, capsys, tmp_path):
     assert d["serve_prefix_hit_ttft_ms_tiered"] == \
         h["serve_prefix_hit_ttft_ms_tiered"] == 41.0
     assert h["serve_prefix_hit_ttft_ms_tiered"] < h["serve_cold_ttft_ms"]
+    # the untiered shed rate (contrast basis — the tiered one gates) is
+    # sidecar-only since ISSUE 17 (headline size cap)
     assert h["serve_shed_rate_poolpressure_tiered"] < \
-        h["serve_shed_rate_poolpressure"]
+        d["serve_shed_rate_poolpressure"]
+    assert "serve_shed_rate_poolpressure" not in h
     assert h["tier_restore_ms_p99"] == 6.3
     assert "serve_tier_restored_pages" not in h      # sidecar-only detail
     # overload + recovery keys (ISSUE 5): shedding must beat the unbounded
     # queue on deadline-miss rate at 2x overload, goodput must hold within
     # 10% of 1x load, and the crash-recovery replay cost rides the headline
     assert d["serve_goodput_2x_overload"] == h["serve_goodput_2x_overload"]
+    # the no-shed miss rate (contrast basis — the shedding one gates) is
+    # sidecar-only since ISSUE 17 (headline size cap)
     assert h["serve_deadline_miss_rate_shed"] < \
-        h["serve_deadline_miss_rate_noshed"]
+        d["serve_deadline_miss_rate_noshed"]
+    assert "serve_deadline_miss_rate_noshed" not in h
     assert h["serve_goodput_2x_vs_1x"] >= 0.9
     assert h["serve_recovery_replay_ms"] == 118.0
     # the 1x goodput (contrast basis of the 2x-vs-1x ratio, which gates)
@@ -744,6 +756,103 @@ def test_bench_regress_committed_r09_gates_tp_keys(tmp_path):
     rc, summary, _ = _regress(REPO / "BENCH_r09.json", tmp_path / "bad.json")
     assert rc == 1
     assert "serve_kv_pool_capacity_x_tp" in \
+        [r["key"] for r in summary["regressions"]]
+
+
+def test_report_paged_kernel_keys(monkeypatch, capsys, tmp_path):
+    """ISSUE 17 satellite: the paged-kernel/int8-KV keys ride the report
+    (mocked serving section) — kernel throughput, the int8-vs-slab
+    sizing ratio and the zero-tolerance greedy agreement gate from the
+    headline; the absolute int8 pool bytes, the host-ops count and the
+    basis string stay in the sidecar."""
+    d, h = _run_main(monkeypatch, capsys, tmp_path,
+                     {1: 0.263, 2: 0.463, 3: 0.663, 4: 0.863})
+    for key in ("serve_tokens_per_sec_paged_kernel",
+                "paged_hbm_bytes_vs_slab_int8",
+                "serve_greedy_match_rate_int8kv"):
+        assert key in h, key
+        assert h[key] == d[key]
+    for key in ("paged_hbm_bytes_int8",
+                "serve_paged_kernel_host_ops_per_block",
+                "serve_paged_kernel_basis"):
+        assert key in d and key not in h
+    assert h["serve_greedy_match_rate_int8kv"] == 1.0
+    assert h["paged_hbm_bytes_vs_slab_int8"] <= 0.5
+
+
+def test_bench_regress_paged_kernel_direction_rules(tmp_path):
+    """Direction-of-goodness for the paged-kernel keys: kernel tok/s is
+    higher-better with throughput noise tolerance; the int8-vs-slab
+    sizing ratio is lower-better and tight (it is deterministic at fixed
+    dims — only a layout regression moves it); the int8 greedy agreement
+    is zero-tolerance (ANY drop means quantization error started
+    flipping greedy tokens)."""
+    keys = ["serve_tokens_per_sec_paged_kernel",
+            "paged_hbm_bytes_vs_slab_int8", "serve_greedy_match_rate_int8kv"]
+    base = {"headline_keys": keys,
+            "serve_tokens_per_sec_paged_kernel": 450.0,
+            "paged_hbm_bytes_vs_slab_int8": 0.14,
+            "serve_greedy_match_rate_int8kv": 1.0}
+    flipped = dict(base, serve_greedy_match_rate_int8kv=0.996)
+    fattened = dict(base, paged_hbm_bytes_vs_slab_int8=0.17)
+    noisy = dict(base, serve_tokens_per_sec_paged_kernel=418.0)
+    slowed = dict(base, serve_tokens_per_sec_paged_kernel=380.0)
+    for name, doc in (("base", base), ("flipped", flipped),
+                      ("fattened", fattened), ("noisy", noisy),
+                      ("slowed", slowed)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "flipped.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_greedy_match_rate_int8kv"
+    assert summary["regressions"][0]["direction"] == "higher"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "fattened.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "paged_hbm_bytes_vs_slab_int8"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "noisy.json")
+    assert rc == 0, "7% throughput noise must not gate"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "slowed.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_tokens_per_sec_paged_kernel"
+
+
+def test_bench_regress_committed_r10_gates_kernel_keys(tmp_path):
+    """ISSUE 17 satellite: BENCH_r10 (scripts/bench_cpu_basis.py
+    --kernel-update over r09) carries the paged-kernel/int8-KV keys no
+    prior artifact could (the kernel and int8 pools postdate r09).
+    Self-pass, r09 -> r10 lands them as new_key, the committed values
+    meet the acceptance bars (int8 pool <= 0.5x the un-quantized slab,
+    greedy agreement exactly 1.0, decode host ops still 2/block), and an
+    injected match-rate drop exits 1 naming the key."""
+    doc = json.loads((REPO / "BENCH_r10.json").read_text())
+    assert doc["rc"] == 0 and "--kernel-update" in doc["cmd"]
+    p = doc["parsed"]
+    for key in ("serve_tokens_per_sec_paged_kernel",
+                "paged_hbm_bytes_vs_slab_int8",
+                "serve_greedy_match_rate_int8kv", "paged_hbm_bytes_int8",
+                "serve_paged_kernel_host_ops_per_block"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    # the acceptance criteria, pinned on the committed artifact
+    assert p["paged_hbm_bytes_vs_slab_int8"] <= 0.5
+    assert p["serve_greedy_match_rate_int8kv"] == 1.0
+    assert p["serve_paged_kernel_host_ops_per_block"] == 2.0
+    rc, summary, err = _regress(REPO / "BENCH_r10.json",
+                                REPO / "BENCH_r10.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    rc, summary, _ = _regress(REPO / "BENCH_r09.json",
+                              REPO / "BENCH_r10.json")
+    assert rc == 0, "new kernel keys must land as new_key over r09"
+    bad = dict(doc, parsed=dict(p, serve_greedy_match_rate_int8kv=0.98))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r10.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert "serve_greedy_match_rate_int8kv" in \
         [r["key"] for r in summary["regressions"]]
 
 

@@ -178,6 +178,35 @@ def test_inference_runner_serve_paged_tiny(capsys):
     assert report["kv_hbm_bytes"] > 0 and report["kv_hbm_vs_slab"] > 0
 
 
+def test_inference_runner_serve_paged_kernel_int8_tiny(capsys):
+    """ISSUE 17 CI gate: runner.py serve --paged-kernel --kv_dtype int8
+    (no --paged needed — the knobs imply it) drives the fused Pallas
+    decode kernel in interpret mode over int8 KV pages — requests
+    complete, the dispatch contract holds, the report names the
+    storage/kernel knobs, and per-chip pool bytes land at ≤ 0.55× the
+    fp32 run of the SAME shape (pages + fp32 scales vs fp32 pages)."""
+    import runner
+
+    args = ["serve", "--tiny", "--page_size", "4",
+            "--max_batch", "2", "--num_requests", "4",
+            "--max_new_tokens", "6", "--fused_steps", "3",
+            "--shared_prefix_len", "8", "--mean_interarrival", "3.0"]
+    runner.main(args + ["--paged-kernel", "--kv_dtype", "int8"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["host_ops_per_block"] == 2.0
+    assert report["paged"] is True
+    assert report["paged_attn_kernel"] is True
+    assert report["page_dtype"] == "int8"
+    runner.main(args + ["--paged"])
+    fp32 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert fp32["page_dtype"] == "float32"
+    assert fp32["paged_attn_kernel"] is False
+    assert report["kv_hbm_bytes"] <= 0.55 * fp32["kv_hbm_bytes"]
+    assert report["kv_slab_hbm_bytes"] == fp32["kv_slab_hbm_bytes"]
+
+
 def test_inference_runner_serve_chunked_tiny(capsys):
     """ISSUE 4 CI gate: runner.py serve --prefill_chunk_tokens drives the
     stall-free chunked-admission path over a heavy-tailed trace (every 2nd

@@ -95,7 +95,7 @@ def test_bad_fixture_finding_shapes():
                    "storm", "*_pins map", "bare-set iteration",
                    "wall-clock", "unseeded", "ghost_ratio",
                    "dead_knob_prob", "ghost_key", "ghost_event",
-                   "retired_key", "serve_thing_ms"):
+                   "retired_key", "serve_thing_ms", "no producing store"):
         assert needle in msgs, f"missing defect class: {needle}"
 
 

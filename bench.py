@@ -1873,6 +1873,14 @@ def bench_serving(layers=8, prompt_len=128, max_batch=4, fused_steps=16):
                                   max_batch=max_batch,
                                   fused_steps=fused_steps))
 
+    # --- async double-buffered block loop (ISSUE 19 tentpole evidence):
+    # factored out as bench_async_loop() so scripts/bench_cpu_basis.py
+    # --async-update can refresh just these keys over a committed
+    # baseline. Runs at its own SMALL fused_steps (4) — the regime where
+    # the inter-block host pass dominates and the overlap pays most.
+    out.update(bench_async_loop(lcfg, model.params, prompt_len=prompt_len,
+                                max_batch=max_batch))
+
     # --- TP-sharded serving (ISSUE 16 tentpole evidence): factored out as
     # bench_serving_tp() so scripts/bench_cpu_basis.py --tp-update can
     # refresh just these keys. NOTE: rebuilds its own params per TP world
@@ -2151,6 +2159,87 @@ def bench_paged_kernel(lcfg, params, prompt_len=128, max_batch=4,
     return out
 
 
+def bench_async_loop(lcfg, params, prompt_len=128, max_batch=4,
+                     fused_steps=4) -> dict:
+    """Async double-buffered block loop (ISSUE 19 tentpole evidence), a
+    standalone function like :func:`bench_paged_kernel` so
+    ``scripts/bench_cpu_basis.py --async-update`` can refresh JUST these
+    keys over a committed artifact. Two claims, one trace:
+
+    * ``serve_interblock_gap_ms`` — mean device idle between consecutive
+      fused blocks (fetch-end -> next-dispatch-start, read off the
+      tracer's dispatch-lane spans by ``interblock_gaps``) with
+      ``async_loop=True``. The pipelined loop dispatches block t+1 BEFORE
+      fetching block t, so this is ~0 by construction; the sync basis it
+      must undercut >= 2x rides the sidecar as
+      ``serve_interblock_gap_ms_sync``;
+    * ``serve_tokens_per_sec_async_smallK`` — end-to-end async engine
+      throughput at SMALL K (``fused_steps`` defaults to 4 here, not
+      bench_serving's 16): with few tokens per block the inter-block host
+      pass is the dominant per-token cost, so this is where overlapping
+      it with device execution pays most. The sync companion rides the
+      sidecar as ``serve_tokens_per_sec_sync_smallK``.
+
+    The async streams are checked bit-identical to the sync oracle's
+    inline — any divergence raises and lands in ``serve_async_error``
+    rather than shipping a wrong throughput number.
+    """
+    from neuronx_distributed_tpu.inference import CausalLM, ServeEngine
+    from neuronx_distributed_tpu.inference.engine import run_trace, synthetic_trace
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    out = {}
+    try:
+        lm = CausalLM(lcfg, params, LlamaForCausalLM,
+                      buckets=(64, prompt_len), max_batch=max_batch)
+        lm.compile()
+        atrace = synthetic_trace(
+            12, 32000, prompt_lens=(prompt_len,), max_new_tokens=32,
+            mean_interarrival_blocks=0.5, seed=0)
+
+        def arun(async_loop):
+            warm = ServeEngine(lm, block_steps=fused_steps,
+                               async_loop=async_loop)
+            for item in atrace[:max_batch]:
+                warm.submit(item["prompt"], 2)
+            warm.run()
+            eng_ = ServeEngine(lm, block_steps=fused_steps,
+                               async_loop=async_loop)
+            rep_ = run_trace(eng_, atrace)
+            streams = {c.request_id: c.tokens.tolist()
+                       for c in eng_.completed}
+            return rep_, streams
+
+        rep_s, streams_s = arun(False)
+        rep_a, streams_a = arun(True)
+        if streams_a != streams_s:
+            raise AssertionError(
+                "async streams diverged from the sync oracle")
+        out["serve_interblock_gap_ms"] = rep_a.get(
+            "interblock_gap_ms_mean", 0.0)
+        out["serve_tokens_per_sec_async_smallK"] = rep_a["tokens_per_sec"]
+        out["serve_interblock_gap_ms_sync"] = rep_s.get(
+            "interblock_gap_ms_mean")
+        out["serve_tokens_per_sec_sync_smallK"] = rep_s["tokens_per_sec"]
+        out["serve_fetch_blocked_ms_async"] = rep_a.get(
+            "fetch_blocked_ms_mean")
+        out["serve_fetch_blocked_ms_sync"] = rep_s.get(
+            "fetch_blocked_ms_mean")
+        out["serve_async_streams_exact"] = True
+        out["serve_async_basis"] = (
+            f"12 reqs @ 0.5 blocks ({prompt_len}-token prompts, 32 new "
+            f"tokens, fused {fused_steps}-step blocks — SMALL K so the "
+            f"inter-block host pass dominates), same trace sync then "
+            f"async, streams checked bit-identical inline; gap = mean "
+            f"fetch-end->next-dispatch-start on the dispatch lane "
+            f"(interblock_gaps), sync basis in "
+            f"serve_interblock_gap_ms_sync must be >= 2x the async gap")
+        del lm
+    except Exception as e:  # noqa: BLE001 — async section additive, never fatal
+        out["serve_async_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
 def bench_serving_tp(lcfg, prompt_len=128, max_batch=4,
                      fused_steps=16, tp=2) -> dict:
     """TP-sharded serving section (ISSUE 16 tentpole evidence), a
@@ -2335,6 +2424,13 @@ HEADLINE_KEYS = (
     # the sidecar (2000-byte headline tail cap)
     "serve_tokens_per_sec_paged_kernel", "paged_hbm_bytes_vs_slab_int8",
     "serve_greedy_match_rate_int8kv",
+    # async double-buffered block loop (ISSUE 19): mean device idle
+    # between fused blocks (~0 when pipelined — the zero-host-blocking-
+    # between-blocks contract) and async throughput at small K; the sync
+    # bases (serve_interblock_gap_ms_sync — the >= 2x pin denominator —
+    # and serve_tokens_per_sec_sync_smallK), the exactness flag and the
+    # basis string ride the sidecar (2000-byte headline tail cap)
+    "serve_interblock_gap_ms", "serve_tokens_per_sec_async_smallK",
     "serve_prefix_hit_ttft_ms_tiered", "tier_restore_ms_p99",
     # serve_shed_rate_poolpressure and serve_deadline_miss_rate_noshed
     # (the no-mitigation contrast bases — the tiered shed rate and the
@@ -2381,7 +2477,7 @@ HEADLINE_KEYS = (
     "serve_chunked_error", "serve_overload_error", "serve_router_error",
     "serve_tier_error", "serve_multilora_error", "serve_disagg_error",
     "serve_autoscale_error", "serve_structured_error", "sched_soak_error",
-    "serve_tp2_error", "serve_paged_kernel_error",
+    "serve_tp2_error", "serve_paged_kernel_error", "serve_async_error",
 )
 
 

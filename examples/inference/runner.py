@@ -547,6 +547,7 @@ def cmd_serve(args) -> None:
             down_patience_blocks=args.scale_down_idle_blocks,
             cooldown_blocks=args.scale_cooldown_blocks))
     eng_kw = dict(block_steps=args.fused_steps, fused=not args.stepwise,
+                  async_loop=args.async_loop,
                   prefill_chunk_tokens=args.prefill_chunk_tokens,
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
                   block_time_ms=args.block_time_ms,
@@ -863,6 +864,12 @@ def main(argv=None) -> None:
         p.add_argument("--stepwise", action="store_true",
                        help="serve: per-token dispatch baseline (same "
                             "schedule, bit-identical tokens)")
+        p.add_argument("--async", dest="async_loop", action="store_true",
+                       help="serve: pipeline the fused block loop — "
+                            "dispatch block t+1 before fetching block t, "
+                            "so the host scheduling pass overlaps device "
+                            "execution (requires fused mode; streams stay "
+                            "bit-identical to the sync loop)")
         p.add_argument("--prefill_chunk_tokens", type=int, default=0,
                        help="serve: C>0 prefills prompts longer than C in "
                             "C-token chunks interleaved with decode blocks "

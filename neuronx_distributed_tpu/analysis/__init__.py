@@ -12,8 +12,8 @@ container in seconds and is wired into tier-1.
 """
 
 from .core import Finding, RepoCtx, Rule, run_checks  # noqa: F401
-from . import (determinism, host_sync, replication,  # noqa: F401
-               resource_pairing, surface_drift)
+from . import (async_contract, determinism, host_sync,  # noqa: F401
+               replication, resource_pairing, surface_drift)
 
 ALL_RULES = (
     host_sync.RULE,
@@ -21,6 +21,7 @@ ALL_RULES = (
     resource_pairing.RULE,
     determinism.RULE,
     surface_drift.RULE,
+    async_contract.RULE,
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,107 @@
+"""Rule ``async-contract``: zero host-blocking on the pipelined dispatch
+path (ROADMAP #22 — the async double-buffered block loop).
+
+``ServeEngine(async_loop=True)`` re-states the ≤2-host-ops-per-block
+contract as *zero host blocking between consecutive fused-block
+dispatches*: iteration *t* dispatches block *t* while block *t−1* is
+still in flight, and the ONLY blocking call of the steady state — the
+fetch of block *t−1* — happens strictly after dispatch *t*, inside the
+designated harvest helpers (``_harvest_inflight``/``_harvest_rec``/
+``_settle_firsts``/``_flush``). The runtime half of the contract is
+counted by the tracer (``interblock_gaps`` pairs dispatch/fetch spans
+and the async loop's gap is exactly 0); this rule is the static half:
+
+* every function whose name marks it as part of the pipelined path
+  (``async`` in the name) under ``inference/`` must not call a blocking
+  primitive DIRECTLY — no ``.item()``/``.tolist()``/
+  ``.block_until_ready()``, no ``jax.device_get``/``np.asarray``/
+  ``np.array`` host materialization (``jnp.asarray`` is fine: it uploads
+  without fetching), no ``time.sleep``, and no call to the engine's own
+  blocking fetch primitive ``._fetch``;
+* blocking work belongs in the non-async-named harvest helpers those
+  functions delegate to AFTER the next dispatch is in flight — the
+  delegation is the contract, so the rule deliberately does not chase
+  calls transitively.
+
+The naming convention is load-bearing and cheap: anything that joins the
+pipelined path must carry ``async`` in its name (review surface), and
+anything that carries it is statically fenced off from blocking calls.
+Zero-waiver: a blocking call between dispatches silently serializes the
+pipeline back into the sync loop — there is no valid justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import Finding, FileCtx, RepoCtx, Rule
+from .host_sync import SYNC_ATTRS, SYNC_CALLS
+from .tracing import _dotted
+
+RULE_ID = "async-contract"
+
+
+def _async_roots(tree: ast.AST):
+    """Outermost ``*async*``-named function defs (a nested async-named
+    helper is walked once, from its outermost async-named enclosure)."""
+    roots = []
+    covered = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "async" not in node.name or id(node) in covered:
+            continue
+        roots.append(node)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                covered.add(id(sub))
+    return roots
+
+
+def _check_file(fc: FileCtx) -> Iterator[Finding]:
+    for fn in _async_roots(fc.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_ATTRS):
+                yield Finding(
+                    RULE_ID, fc.rel, node.lineno, fc.qualname_at(node),
+                    f".{node.func.attr}() on the pipelined dispatch path "
+                    f"blocks the host between fused-block dispatches")
+            elif dotted in SYNC_CALLS:
+                yield Finding(
+                    RULE_ID, fc.rel, node.lineno, fc.qualname_at(node),
+                    f"{dotted}() on the pipelined dispatch path fetches "
+                    f"to host between fused-block dispatches (stage the "
+                    f"value or move the fetch into the harvest helpers)")
+            elif dotted == "time.sleep":
+                yield Finding(
+                    RULE_ID, fc.rel, node.lineno, fc.qualname_at(node),
+                    "time.sleep() on the pipelined dispatch path stalls "
+                    "the device for the whole sleep")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "_fetch"):
+                yield Finding(
+                    RULE_ID, fc.rel, node.lineno, fc.qualname_at(node),
+                    "._fetch() called directly between dispatches — the "
+                    "deferred fetch belongs in the harvest helpers, after "
+                    "the next block is in flight")
+
+
+def check(ctx: RepoCtx) -> Iterator[Finding]:
+    for fc in ctx.files:
+        if "/analysis/" in fc.rel or "/inference/" not in "/" + fc.rel:
+            continue
+        yield from _check_file(fc)
+
+
+RULE = Rule(
+    id=RULE_ID,
+    doc="zero host-blocking calls between fused-block dispatches on the "
+        "async pipelined path (async-named functions under inference/)",
+    check=check,
+    zero_waiver=True,
+)

@@ -225,7 +225,18 @@ class Autoscaler:
     def observe_block(self, router) -> None:
         """One policy evaluation per router block; runs BEFORE placement
         so freshly spawned capacity takes this block's arrivals. The
-        router calls this — nothing here is wall-clock."""
+        router calls this — nothing here is wall-clock.
+
+        Async block loop: when replicas run ``async_loop=True`` every
+        signal read here (queue depths, utilization, SLO pressure) lags
+        the in-flight block by exactly one harvest — the same one-block
+        lag the engines' own retire path has. Because both sides commit on
+        the virtual block clock, the lag shifts WHEN a threshold trips by
+        at most one block and never reorders decisions, so scale events
+        stay deterministic for a given trace (pinned by the async==sync
+        matrix). Draining a pipelined replica is already safe: the park
+        path waits on ``has_decode_work()`` (which counts in-flight
+        blocks) and ``snapshot()`` drains the pipeline before encoding."""
         self._resolve_ttr(router)
         for i in sorted(router._drained):
             if i not in self._parked_seen and i in router.snapshots:

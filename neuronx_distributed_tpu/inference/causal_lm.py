@@ -28,7 +28,8 @@ import numpy as np
 
 from neuronx_distributed_tpu.inference.paged_cache import PagedKVCache
 from neuronx_distributed_tpu.inference.partition import (
-    leaf_partition_spec, shard_avals, shard_out, zeros_like_avals,
+    leaf_partition_spec, repl_args, repl_avals, shard_avals, shard_out,
+    zeros_like_avals,
 )
 from neuronx_distributed_tpu.inference.sampling import Sampler, SlotSampler
 
@@ -427,7 +428,7 @@ class CausalLM:
         if not self.lora:
             return ()
         return (self._adapter_avals(),
-                jax.ShapeDtypeStruct((rows,), jnp.int32))
+                repl_avals(jax.ShapeDtypeStruct((rows,), jnp.int32)))
 
     def _ad_args(self, pool, idx) -> tuple:
         """Trailing call args: the pool's live tree (identity zeros when no
@@ -491,9 +492,9 @@ class CausalLM:
             "terminal": jax.ShapeDtypeStruct((G, S), jnp.bool_),
         })
         return (tree,
-                jax.ShapeDtypeStruct((rows,), jnp.int32),
-                jax.ShapeDtypeStruct((rows,), jnp.int32),
-                jax.ShapeDtypeStruct((rows,), jnp.int32))
+                *repl_avals((jax.ShapeDtypeStruct((rows,), jnp.int32),
+                             jax.ShapeDtypeStruct((rows,), jnp.int32),
+                             jax.ShapeDtypeStruct((rows,), jnp.int32))))
 
     def _gr_args(self, pool, gidx, gstate, gbudget) -> tuple:
         """Trailing call args: the pool's live tables (identity when no
@@ -714,8 +715,12 @@ class CausalLM:
         Returns the compiled program ``(params, cache, tok (b,1), slot_keys
         (b,) keys, counts (b,), lengths (b,), active (b,), done (b,),
         eos_ids (b,), temperature (b,), greedy (b,)[, *gr]) -> (tokens
-        (steps, b), cache, next_tok, lengths, done)``. Cached per
-        ``(steps, slot_sampler, pad)``.
+        (steps, b), cache, next_tok, lengths, done[, dfa_state])``. The
+        trailing ``dfa_state`` rides out only for grammar-enabled lms: the
+        async double-buffered loop feeds block t+1's grammar quad from
+        block t's OUTPUT without a host fetch, so the final carried state
+        must surface as a device value (the sync path ignores it). Cached
+        per ``(steps, slot_sampler, pad)``.
         """
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
@@ -772,19 +777,29 @@ class CausalLM:
                     else (cache, tok, counts, lengths, done))
             carry, toks = jax.lax.scan(body, init, None, length=steps)
             cache, tok, _counts, lengths, done = carry[:5]
-            return toks, self._shard_out(cache), tok, lengths, done
+            # row outputs pinned replicated: the async loop feeds block
+            # t+1's inputs from these COMMITTED values (and edits them with
+            # eager staged-override ops), so they must come back in exactly
+            # the layout the lowered row inputs require — see repl_args
+            if gr:
+                return (*self._replicate_out((toks,)), self._shard_out(cache),
+                        *self._replicate_out((tok, lengths, done, carry[5])))
+            return (*self._replicate_out((toks,)), self._shard_out(cache),
+                    *self._replicate_out((tok, lengths, done)))
 
         b = self.max_batch
         self._session_fused[key] = self._time_compile(
             f"session_fused_k{steps}",
             lambda: jax.jit(fused_fn, donate_argnums=(1,))
             .lower(self.params, self._cache_avals(),
-                   jnp.zeros((b, 1), jnp.int32),
-                   jax.random.split(jax.random.key(0), b),
-                   jnp.zeros((b,), jnp.int32),
-                   jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
-                   jnp.zeros((b,), bool), jnp.full((b,), -1, jnp.int32),
-                   jnp.ones((b,), jnp.float32), jnp.ones((b,), bool),
+                   *repl_args(jnp.zeros((b, 1), jnp.int32),
+                              jax.random.split(jax.random.key(0), b),
+                              jnp.zeros((b,), jnp.int32),
+                              jnp.zeros((b,), jnp.int32),
+                              jnp.zeros((b,), bool), jnp.zeros((b,), bool),
+                              jnp.full((b,), -1, jnp.int32),
+                              jnp.ones((b,), jnp.float32),
+                              jnp.ones((b,), bool)),
                    *self._ad_lower(b), *self._gr_lower(b))
             .compile())
         return self._session_fused[key]

@@ -112,6 +112,7 @@ from neuronx_distributed_tpu.observability import (
     SLOMonitor,
     Tracer,
 )
+from neuronx_distributed_tpu.observability.tracer import interblock_gaps
 from neuronx_distributed_tpu.observability import attribution as _attribution
 from neuronx_distributed_tpu.inference.adapters import (
     AdapterLoadError,
@@ -404,6 +405,7 @@ class ServeEngine:
         incident_burst_window: int = 8,
         role: str = "both",
         keep_completions: bool = True,
+        async_loop: bool = False,
     ):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(
@@ -453,6 +455,21 @@ class ServeEngine:
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
+        # async double-buffered block loop (ROADMAP #22): dispatch block t,
+        # run the whole scheduling pass, and only fetch block t-1's emissions
+        # AFTER block t+1... i.e. the fetch always trails the dispatch by one
+        # block, so the device never idles between blocks. JAX async dispatch
+        # makes the split free: the fused program call returns device futures
+        # immediately; np.asarray on the token matrix is the only sync. The
+        # sync loop is retained verbatim (_step_block_sync) as the oracle —
+        # streams are bit-identical by construction because every scheduling
+        # decision commits on the virtual block clock, not on fetched data.
+        if async_loop and not fused:
+            raise ValueError(
+                "async_loop requires fused=True — the double-buffered "
+                "pipeline overlaps the fused K-step block program; the "
+                "stepwise oracle is inherently synchronous")
+        self.async_loop = bool(async_loop)
         # prefill/decode disaggregation role (inference/disagg.py): a
         # "prefill" worker runs ONLY insert/extend programs — a finished
         # prompt's first token is sampled here, its KV pages are packaged
@@ -600,6 +617,17 @@ class ServeEngine:
         self._slot_keys = (None if self._sim
                            else jax.random.split(self.rng, b))
         self._gen_counts = np.zeros((b,), np.int32)
+        # async pipeline state (async_loop=True): at most ONE in-flight
+        # dispatched-but-unfetched block record rides _inflight between
+        # iterations (deque so a flush drains in dispatch order); _staged
+        # maps slots admitted/adopted/replayed since the previous dispatch to
+        # their next-dispatch input overrides (None = read the host mirrors,
+        # a dict = deferred device values, see _dispatch_block_async);
+        # _first_pending holds deferred first-token records whose sampler
+        # output was left on device so admission never blocks the pipeline.
+        self._inflight: deque = deque()
+        self._staged: Dict[int, Optional[dict]] = {}
+        self._first_pending: List[dict] = []
         # chunked-prefill state: slot -> in-flight admission, FIFO order
         self._prefilling: Dict[int, _PrefillInFlight] = {}
         self._prefill_q: deque[int] = deque()
@@ -735,7 +763,7 @@ class ServeEngine:
         if self.paged:
             pkv = self.session.paged
             need = pkv.pages_needed(prompt.size,
-                                    max_new_tokens + self.block_steps)
+                                    max_new_tokens + self._reserve_slack())
             if need > pkv.capacity_pages():
                 # reject now: a request no drained pool could ever hold
                 # would otherwise deadlock the admission queue
@@ -888,6 +916,17 @@ class ServeEngine:
                 return True
         for slot, req in enumerate(self.slots):
             if req is not None and req.request_id == request_id:
+                # async: the in-flight block still includes this row; drain
+                # it (recording its deliveries — the client had them coming)
+                # before the partial completion is cut. The drain may reveal
+                # the stream already finished — then it completes normally
+                # (exactly what the sync loop would have delivered) and the
+                # cancel finds nothing to cut.
+                if self.async_loop:
+                    self._flush()
+                    self._retire_finished()
+                    if self.slots[slot] is not req:
+                        return False
                 self.lm.retire(self.session, np.asarray([slot], np.int32))
                 self._complete_slot(slot, cancelled=True)
                 self.stats["cancelled"] += 1
@@ -1144,6 +1183,18 @@ class ServeEngine:
         rate = max(self.lm.max_batch * self.block_steps, 1)
         return max(1, -(-(queued + inflight) // rate))
 
+    def _reserve_slack(self) -> int:
+        """Decode-overrun page reserve beyond ``max_new_tokens``. The sync
+        loop retires a finished row at the block boundary its EOS/budget
+        latch was fetched, so a row writes at most ``block_steps - 1`` cache
+        positions past its last delivered token. The async pipeline learns
+        the latch one block LATER (block t's fetch lands while t+1 runs),
+        so a finished row rides exactly one extra dispatched block before
+        retire — double the reserve. Same safety argument as sync: the
+        over-written positions are covered by reserved pages the slot owns
+        and retire's scratch-table reset unmaps them before reuse."""
+        return self.block_steps * 2 if self.async_loop else self.block_steps
+
     def _pool_can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Whether the page pool could cover this admission RIGHT NOW
         (free pages plus whatever reclaim — tier spill of cache-only pages,
@@ -1156,7 +1207,7 @@ class ServeEngine:
         # only (the decode reserve is the ADOPTING worker's cost)
         need = pkv.pages_needed(prompt_len,
                                 0 if self.role == "prefill"
-                                else max_new_tokens + self.block_steps)
+                                else max_new_tokens + self._reserve_slack())
         free = pkv.allocator.available()
         if free < need and pkv.prefix is not None:
             free += pkv.prefix.reclaimable_pages()
@@ -1176,7 +1227,7 @@ class ServeEngine:
         if (req is not None and pkv is not None and pkv.prefix is not None
                 and pkv.tier is not None):
             need = pkv.pages_needed(req.prompt.size,
-                                    req.max_new_tokens + self.block_steps)
+                                    req.max_new_tokens + self._reserve_slack())
             if (pkv.allocator.available()
                     + pkv.prefix.spillable_pages()) >= need:
                 return 1
@@ -1407,6 +1458,10 @@ class ServeEngine:
         self._adapter_idx[slot] = 0
         self._gidx[slot] = 0
         self._gstate[slot] = 0
+        # async: a retired slot's next-dispatch override is void (a reused
+        # slot gets a fresh one at its own admission); in-flight blocks that
+        # still include this row are rid-gated at harvest
+        self._staged.pop(slot, None)
 
     def _trace_queued(self, req: Request, now: float) -> None:
         """Close the request's 'queued' lifecycle span (submit wall stamp ->
@@ -1489,15 +1544,30 @@ class ServeEngine:
 
     def _expire_decoding(self) -> None:
         """Completion-deadline expiry for live streams: retire NOW with the
-        tokens delivered so far (partial, ``expired=True``)."""
-        for slot, req in enumerate(self.slots):
-            if req is None or slot in self._prefilling or self._done[slot]:
-                continue
-            if (req.deadline_block is not None
-                    and self.blocks > req.deadline_block):
-                self.lm.retire(self.session, np.asarray([slot], np.int32))
-                self._complete_slot(slot, expired=True)
-                self.stats["expired"] += 1
+        tokens delivered so far (partial, ``expired=True``).
+
+        Async: the expiry DECISION is pure virtual-clock (identical either
+        way), but the partial's content would be one block short while a
+        block is in flight — so the first victim triggers a pipeline flush
+        (rare, and exactly the designated-sync-point discipline), making the
+        delivered partial bit-identical to the sync loop's."""
+        victims = [
+            slot for slot, req in enumerate(self.slots)
+            if req is not None and slot not in self._prefilling
+            and not self._done[slot]
+            and req.deadline_block is not None
+            and self.blocks > req.deadline_block]
+        if not victims:
+            return
+        if self.async_loop:
+            self._flush()
+        for slot in victims:
+            req = self.slots[slot]
+            if req is None or self._done[slot]:
+                continue     # the flush finished it — normal retire path
+            self.lm.retire(self.session, np.asarray([slot], np.int32))
+            self._complete_slot(slot, expired=True)
+            self.stats["expired"] += 1
 
     def _is_chunked(self, req: Request) -> bool:
         return bool(self.prefill_chunk_tokens
@@ -1631,7 +1701,7 @@ class ServeEngine:
         # by the adopting decode worker.
         reserve = np.asarray(
             [0 if self.role == "prefill"
-             else r.max_new_tokens + self.block_steps for r in group],
+             else r.max_new_tokens + self._reserve_slack() for r in group],
             np.int64)
         aslots = (np.asarray([self._adapter_slot(r) for r in group], np.int32)
                   if self.lora else None)
@@ -1649,6 +1719,15 @@ class ServeEngine:
         self.stats["inserted_requests"] += rows
         temps = np.asarray([r.temperature for r in group], np.float32)
         greedy = np.asarray([r.greedy for r in group], bool)
+        # async pipeline: fetching the sampled first tokens here would block
+        # on the insert program, which chains AFTER the in-flight decode
+        # block (session.cache is its donated output future) — serializing
+        # the very overlap the loop exists for. Leave the sampler result on
+        # device; _settle_firsts records the host values at the next harvest
+        # (the designated sync point). A prefill worker never defers: it has
+        # no decode pipeline and _handoff_group needs the token NOW.
+        defer = self.async_loop and self.role != "prefill"
+        first_dev = None
         if self._sim:
             # host-only simulation: the stub's deterministic token
             # function replaces the whole jax sampling path (no XLA)
@@ -1670,8 +1749,9 @@ class ServeEngine:
             logits = self._mask_logits(
                 logits, self._grammar_allowed_rows(group, [0] * rows,
                                                    [0] * rows))
-            first = np.asarray(self.slot_sampler(
-                logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))
+            first_dev = self.slot_sampler(
+                logits, sub, jnp.asarray(temps), jnp.asarray(greedy))
+            first = None if defer else np.asarray(first_dev)
         now = time.perf_counter()
         for i, (r, slot) in enumerate(zip(group, slot_ids)):
             r.start_block = self.blocks
@@ -1687,7 +1767,6 @@ class ServeEngine:
             self._eos[slot] = -1 if r.eos_token_id is None else r.eos_token_id
             self._temp[slot] = temps[i]
             self._greedy[slot] = greedy[i]
-            self._tok[slot] = int(first[i])
             if not self._sim:
                 self._slot_keys = self._slot_keys.at[slot].set(keys[i])
             self._gen_counts[slot] = 1
@@ -1695,8 +1774,25 @@ class ServeEngine:
             self._gidx[slot] = self._grammar_slot(r)
             self._gstate[slot] = 0
             self._gbudget[slot] = r.max_new_tokens
-            self._record(slot, int(first[i]), now)
-            self._advance_grammar(slot, int(first[i]))
+            if defer:
+                # the RECORD (and in sim, only the record — the value is
+                # host-known) waits for the harvest so a 1-token budget
+                # retires on the same virtual block in sim and real mode
+                self._first_pending.append({
+                    "slot": slot, "rid": r.request_id, "idx": i,
+                    "fut": first_dev, "block": self.blocks,
+                    "val": None if first is None else int(first[i])})
+                if self._sim:
+                    self._tok[slot] = int(first[i])
+                    self._staged[slot] = None
+                else:
+                    self._staged[slot] = {"fut": first_dev, "idx": i}
+            else:
+                self._tok[slot] = int(first[i])
+                self._record(slot, int(first[i]), now)
+                self._advance_grammar(slot, int(first[i]))
+                if self.async_loop:
+                    self._staged[slot] = None
         if self.role == "prefill":
             # disaggregation: the prompt's KV is done and its first token
             # sampled — hand the pages to the decode pool and free the slot
@@ -1714,7 +1810,7 @@ class ServeEngine:
         if self.paged:
             tier_before = self._tier_marker()
             reserve = (0 if self.role == "prefill"
-                       else req.max_new_tokens + self.block_steps)
+                       else req.max_new_tokens + self._reserve_slack())
             chunk = self.session.paged.begin_chunked(
                 req.prompt.tolist(), req.prompt.size + reserve,
                 ns=req.adapter)
@@ -1801,6 +1897,8 @@ class ServeEngine:
         self.stats["inserted_requests"] += 1
         temps = np.asarray([req.temperature], np.float32)
         greedy = np.asarray([req.greedy], bool)
+        defer = self.async_loop and self.role != "prefill"
+        first_dev = None
         if self._sim:
             first = self.lm.sim_token(req.request_id, 0)
         else:
@@ -1809,8 +1907,12 @@ class ServeEngine:
                                                jnp.zeros((1,), jnp.int32))
             logits = self._mask_logits(
                 logits, self._grammar_allowed_rows([req], [0], [0]))
-            first = int(np.asarray(self.slot_sampler(
-                logits, sub, jnp.asarray(temps), jnp.asarray(greedy)))[0])
+            # async: same deferral as _insert_group — the sampler output
+            # chains after the in-flight decode block, so fetching it here
+            # would stall the pipeline
+            first_dev = self.slot_sampler(
+                logits, sub, jnp.asarray(temps), jnp.asarray(greedy))
+            first = None if defer else int(np.asarray(first_dev)[0])
         req.first_token_block = self.blocks
         self._observe_first_token(req, slot, time.perf_counter(),
                                   chunked=True)
@@ -1823,13 +1925,26 @@ class ServeEngine:
         self._eos[slot] = -1 if req.eos_token_id is None else req.eos_token_id
         self._temp[slot] = temps[0]
         self._greedy[slot] = greedy[0]
-        self._tok[slot] = first
         self._gen_counts[slot] = 1
         self._gidx[slot] = self._grammar_slot(req)
         self._gstate[slot] = 0
         self._gbudget[slot] = req.max_new_tokens
-        self._record(slot, first, time.perf_counter())
-        self._advance_grammar(slot, first)
+        if defer:
+            self._first_pending.append({
+                "slot": slot, "rid": req.request_id, "idx": 0,
+                "fut": first_dev, "block": self.blocks,
+                "val": first if self._sim else None})
+            if self._sim:
+                self._tok[slot] = first
+                self._staged[slot] = None
+            else:
+                self._staged[slot] = {"fut": first_dev, "idx": 0}
+        else:
+            self._tok[slot] = first
+            self._record(slot, first, time.perf_counter())
+            self._advance_grammar(slot, first)
+            if self.async_loop:
+                self._staged[slot] = None
         if self.role == "prefill":
             self._handoff_group([slot])
 
@@ -1853,6 +1968,7 @@ class ServeEngine:
         self._adapter_idx[slot] = 0
         self.session.lengths[slot] = 0
         self.session.active[slot] = False
+        self._staged.pop(slot, None)
         self.stats["prefill_aborts"] += 1
         if self.tracer.enabled:
             self.tracer.instant(
@@ -1919,6 +2035,12 @@ class ServeEngine:
         largest-bucket ``extend`` chunks (prefix-cache hits skip shared
         pages where they survive), then sample token ``g`` under
         ``fold_in(req_key, g)`` — bit-identical to the uninterrupted run."""
+        # async: a replay is recovery work, not the steady-state path — it
+        # samples its resumed token synchronously, so drain the pipeline
+        # first (designated sync point; the next dispatch restarts cold from
+        # the host mirrors, which this admission is about to set)
+        if self.async_loop:
+            self._flush()
         aslot = 0
         if self.lora and req.adapter is not None:
             # re-pin the stream's adapter BEFORE any page work (it may have
@@ -1950,7 +2072,7 @@ class ServeEngine:
             tier_before = self._tier_marker()
             st = pkv.begin_chunked(
                 seq.tolist(),
-                total + (req.max_new_tokens - g) + self.block_steps,
+                total + (req.max_new_tokens - g) + self._reserve_slack(),
                 ns=req.adapter)
             written = st.start
             self._note_tier_restore([req], tier_before)
@@ -2039,6 +2161,8 @@ class ServeEngine:
                 ts=now, args={"slot": int(slot), "resumed_at": int(g)})
         self._record(slot, tok, now)
         self._advance_grammar(slot, tok)
+        if self.async_loop:
+            self._staged[slot] = None
         self.stats["inserts"] += 1
         self.stats["inserted_requests"] += 1
 
@@ -2176,6 +2300,14 @@ class ServeEngine:
         through one — their streams resume bit-identical (per-request
         rng)."""
         pkv = self.session.paged
+        # async: recovery reads _out (delivered-so-far) to rebuild replay
+        # records — drain the pipeline first so those records are whole,
+        # then retire streams the drain completed: a finished stream's KV
+        # needs no repair, and replaying it would sample one token past
+        # its budget (_replay_admission resumes at len(pregen))
+        if self.async_loop:
+            self._flush()
+            self._retire_finished()
         bad = {int(p) for p in pages}
         all_bad = sorted(bad)
         replays_before = self.stats["corrupt_page_replays"]
@@ -2394,7 +2526,7 @@ class ServeEngine:
             pages = pkv.adopt_pages(
                 slot, req.prompt.tolist(), h.payloads,
                 self._write_pages_bytes,
-                req.prompt.size + req.max_new_tokens + self.block_steps)
+                req.prompt.size + req.max_new_tokens + self._reserve_slack())
         except PagePoolExhausted:
             self.stats["deferred_admissions"] += 1
             self._note_pool_pressure([req])
@@ -2429,6 +2561,12 @@ class ServeEngine:
             self._grammar_walk(req.grammar, 0, [int(h.first_token)])
             if gslot else 0)
         self._gbudget[slot] = req.max_new_tokens
+        # async: the adopted row enters the NEXT dispatch via the host
+        # mirrors set above (its first token is host-known — no deferral);
+        # the functional cache updates chain after any in-flight block
+        # automatically, and that block's inputs captured the old tables
+        if self.async_loop:
+            self._staged[slot] = None
         self.stats["handoffs_adopted"] += 1
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._m_handoff.observe(dt_ms)
@@ -2509,8 +2647,11 @@ class ServeEngine:
 
     def has_decode_work(self) -> bool:
         """True while any slot still runs (decoding or mid-prefill) or a
-        recovery replay is pending — the Router's drain-completion gate."""
+        recovery replay is pending — the Router's drain-completion gate.
+        Async: a dispatched-but-unfetched block or an unsettled deferred
+        first token is work too (its emissions are not recorded yet)."""
         return (bool(self._replay_q) or bool(self._prefilling)
+                or bool(self._inflight) or bool(self._first_pending)
                 or any(r is not None for r in self.slots))
 
     # --- snapshot / restore ------------------------------------------------
@@ -2525,6 +2666,16 @@ class ServeEngine:
         if self._sim:
             raise ValueError(
                 "sim engines have no rng/device state to snapshot")
+        # async: the snapshot serializes _out (delivered-so-far) per stream;
+        # drain the pipeline so the capture is a true block boundary — the
+        # restored engine replays prompt+generated and resumes bit-identical.
+        # The drain may latch done for streams that finished in flight:
+        # retire them NOW (exactly what the next scheduling pass would do)
+        # or the snapshot would encode an already-complete stream as
+        # "decoding" and the restore would decode past its budget
+        if self.async_loop:
+            self._flush()
+            self._retire_finished()
 
         def enc(r: Request, state: str, generated: List[int]) -> dict:
             # constrained streams carry (grammar name, DFA state): the
@@ -2590,6 +2741,7 @@ class ServeEngine:
                 "dispatch_retries": self.dispatch_retries,
                 "host_tier_pages": self.host_tier_pages,
                 "paged": self.paged,
+                "async_loop": self.async_loop,
             },
             # tier CONTENT is deliberately dropped (host buffers die with
             # the process, exactly like device pages); the knob above makes
@@ -2633,6 +2785,10 @@ class ServeEngine:
             # tier knob has no meaning there (streams are identical anyway)
             cfg.pop("host_tier_pages", None)
         cfg.update(overrides)
+        if not cfg.get("fused", True):
+            # restoring into the stepwise oracle: the pipeline knob only
+            # exists on the fused path (streams are identical anyway)
+            cfg.pop("async_loop", None)
         rng = jax.random.wrap_key_data(
             jnp.asarray(snap["rng"], jnp.uint32))
         eng = cls(lm, rng=rng, **cfg)
@@ -2683,9 +2839,13 @@ class ServeEngine:
         eng._drain_replays()
         return eng
 
-    def _record(self, slot: int, token: int, ts: float) -> None:
+    def _record(self, slot: int, token: int, ts: float,
+                block: Optional[int] = None) -> None:
         """Append one emitted token to the slot's request; latch done on EOS
-        or exhausted budget (the host half of the retire-on-EOS contract)."""
+        or exhausted budget (the host half of the retire-on-EOS contract).
+        ``block`` overrides the virtual-block stamp on the token instant —
+        the async loop harvests block t's emissions one iteration later and
+        must stamp them with the block that EMITTED them."""
         req = self.slots[slot]
         if req is None or self._done[slot]:
             return
@@ -2702,7 +2862,8 @@ class ServeEngine:
         self._last_tok_ts[req.request_id] = ts
         if self.tracer.enabled:
             self.tracer.instant(
-                "tok", ("req", req.request_id), block=self.blocks, ts=ts,
+                "tok", ("req", req.request_id),
+                block=self.blocks if block is None else block, ts=ts,
                 args={"t": int(token), "i": len(out) - 1})
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._done[slot] = True
@@ -2794,16 +2955,20 @@ class ServeEngine:
                     state=self.state_summary(), slo=self.slo_status()):
                 self._pool_pressure_blocks.clear()
 
-    def _fetch(self, arr) -> np.ndarray:
+    def _fetch(self, arr, block: Optional[int] = None) -> np.ndarray:
         """The block's host fetch, as an observable span: device->host copy
         of the emitted token matrix (the 2nd of the <= 2 host ops per fused
-        block)."""
+        block). ``block`` stamps the span with the block being fetched —
+        the async loop fetches block t while the counter already reads t+1.
+        The fetch/dispatch span pairing on this lane is the measured half
+        of the zero-host-blocking contract (``interblock_gaps``)."""
         if not self.tracer.enabled:
             return np.asarray(arr)
         t0 = time.perf_counter()
         out = np.asarray(arr)
         self.tracer.complete("fetch", (self.lane, "dispatch"), t0,
-                             time.perf_counter(), block=self.blocks)
+                             time.perf_counter(),
+                             block=self.blocks if block is None else block)
         return out
 
     def step_block(self) -> bool:
@@ -2811,7 +2976,20 @@ class ServeEngine:
         first), spend the prefill-chunk budget, advance every active slot
         ``block_steps`` tokens, record emissions, expire past-deadline
         streams, retire finished slots. Returns False when there is nothing
-        left to do at the current virtual time."""
+        left to do at the current virtual time.
+
+        With ``async_loop=True`` the same round runs double-buffered: the
+        scheduling pass commits on state as of block t-2's harvest, block t
+        dispatches, and only THEN is block t-1 fetched+harvested — the
+        device never waits on the host between blocks (the pipelined
+        variant; same decisions, same streams — see _step_block_async)."""
+        if self.async_loop:
+            return self._step_block_async()
+        return self._step_block_sync()
+
+    def _step_block_sync(self) -> bool:
+        """The synchronous block loop — the exactness oracle the async
+        pipeline is tested bit-identical against."""
         self._emitted.clear()     # harvest reads last block's emissions
         self.queue.advance(self.blocks)
         self._drain_replays()     # recovery work re-enters ahead of admits
@@ -2895,8 +3073,10 @@ class ServeEngine:
                                       self._adapter_idx),
                     *self.lm._gr_args(self.session.grammars, self._gidx,
                                       self._gstate, self._gbudget))
-            toks, cache, _nxt, _len, _done = self._dispatch(
-                "decode", lambda: fused(*args))
+            # 5 outputs, or 6 with grammar (the trailing DFA state exists
+            # for the async pipeline; the sync loop ignores it)
+            outs = self._dispatch("decode", lambda: fused(*args))
+            toks, cache = outs[0], outs[1]
             self.session.cache = cache
             self.session.lengths = self.session.lengths + self.block_steps
             self.stats["program_calls"] += 1
@@ -2957,6 +3137,289 @@ class ServeEngine:
             done = done | (self._active & (lengths + 1 >= max_len))
             tok = nxt.astype(np.int32)
         return out
+
+    # --- the async double-buffered pipeline (ROADMAP #22) -----------------
+    # One-block pipeline depth: while block t's fused scan runs on device,
+    # the host runs the whole scheduling pass and only then fetches block
+    # t-1. Correctness rests on three facts. (1) Every scheduling decision
+    # already commits on the virtual block clock and host mirrors — never on
+    # the fetched matrix of the block being decided — so a one-block harvest
+    # lag reorders NOTHING. (2) Block t+1's device inputs are block t's
+    # device OUTPUTS (next-token, done, DFA-state futures chained without a
+    # fetch), plus host-known per-slot overrides for rows admitted in
+    # between — exactly the values the sync loop would have uploaded.
+    # (3) Emissions a finished row over-produces before its (one block
+    # later) retire are discarded by the same host done-latch that already
+    # discards mid-block post-EOS samples in sync mode, and their cache
+    # writes land in the enlarged page reserve (_reserve_slack). Streams
+    # are therefore bit-identical by construction; tests/test_async_loop.py
+    # pins it across the whole exactness matrix.
+
+    def _step_block_async(self) -> bool:
+        """One pipelined scheduling round. Ordering per iteration t:
+        schedule (on state as of harvest t-1) -> dispatch block t ->
+        fetch+harvest block t-1 (the single blocking host op, paid while
+        block t runs) -> expire/retire. Designated sync points (snapshot,
+        cancel, replay admission, corruption recovery, deadline expiry,
+        end-of-work) drain the pipeline via _flush; between them the host
+        never blocks between dispatches — the tracer's dispatch/fetch span
+        gap measures exactly 0 (interblock_gaps) and the nxdcheck
+        ``async-contract`` rule forbids blocking primitives on this path."""
+        self._emitted.clear()
+        self.queue.advance(self.blocks)
+        self._drain_replays()
+        self._admit()
+        self._retire_finished()
+        self._admit()
+        self._expire_prefilling()
+        self._advance_prefill()
+        self._retire_finished()
+        if self._injector is not None and self.paged:
+            victims = self._injector.pages_to_corrupt(
+                self.session.paged.live_pages())
+            if victims:
+                self._handle_corrupt_pages(victims)
+        self._observe_block()
+        if not self._active.any():
+            # nothing to dispatch: drain the pipeline (its harvest may
+            # finish streams) and either terminate or advance virtual time
+            self._flush()
+            self._retire_finished()
+            if (not self.queue and not self._prefilling
+                    and not self._replay_q and not self._active.any()):
+                return False
+            self.blocks += 1
+            self.stats["blocks"] += 1
+            return True
+        t0 = time.perf_counter()
+        self._dispatch_block_async()
+        self.stats["blocks"] += 1
+        self.stats["decode_blocks"] += 1
+        self._harvest_inflight()
+        now = time.perf_counter()
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "decode_block", (self.lane, "blocks"), t0, now,
+                block=self.blocks,
+                args={"active": int(self._active.sum()),
+                      "steps": self.block_steps, "fused": True,
+                      "inflight": len(self._inflight)})
+        self.blocks += 1
+        self._expire_decoding()
+        self._retire_finished()
+        return True
+
+    def _budget_done(self) -> np.ndarray:
+        """Host-side prediction of per-row budget exhaustion after the
+        blocks dispatched so far. The device never latches budget-done (the
+        host's _record does, from the fetch) — so the pipelined dispatch
+        ORs this into the carried done input, keeping block t+1's inputs
+        bit-identical to what the sync loop would upload."""
+        maxn = np.asarray(
+            [0 if r is None else r.max_new_tokens for r in self.slots],
+            np.int64)
+        return self._active & (self._gen_counts >= maxn)
+
+    def _sim_end_done(self, toks: np.ndarray,
+                      done_in: np.ndarray) -> np.ndarray:
+        """Sim-mode stand-in for the device's carried done latches: the
+        eager sim 'dispatch' computes what the real scan would carry out of
+        this block (EOS per emitted token, plus the budget OR the real
+        pipeline applies at the next dispatch), so sim and real async mode
+        run the SAME schedule — the sim-vs-real schedule pins hold."""
+        done = done_in.copy()
+        for slot, req in enumerate(self.slots):
+            if (req is None or slot in self._prefilling
+                    or not self._active[slot]):
+                continue
+            e = int(self._gen_counts[slot])
+            for k in range(toks.shape[0]):
+                if done[slot]:
+                    break
+                t = int(toks[k, slot])
+                e += 1
+                if req.eos_token_id is not None and t == req.eos_token_id:
+                    done[slot] = True
+                if e >= req.max_new_tokens:
+                    done[slot] = True
+        return done
+
+    def _dispatch_block_async(self) -> None:
+        """Dispatch one fused block WITHOUT fetching anything. Warm (an
+        unfetched block is in flight): device inputs are the previous
+        dispatch's output futures — next-token, done (ORed with the host's
+        budget prediction) and DFA state chain on device. Cold (first block
+        after a flush): inputs come from the host mirrors, exactly like the
+        sync loop. Either way, slots admitted/adopted/replayed since the
+        previous dispatch are applied LAST as per-slot overrides (host ints
+        where the value is known, device gathers where the first token is
+        itself still in flight). Appends the in-flight record; the matching
+        fetch happens in _harvest_inflight one iteration later."""
+        rids = [(-1 if (r is None or i in self._prefilling)
+                 else r.request_id) for i, r in enumerate(self.slots)]
+        prev = self._inflight[-1] if self._inflight else None
+        if self._sim:
+            done_in = (prev["end_done"] if prev is not None
+                       else self._done).copy()
+            for slot in self._staged:
+                done_in[slot] = self._done[slot]
+            all_rids = [(-1 if r is None else r.request_id)
+                        for r in self.slots]
+            toks = self._dispatch(
+                "decode", lambda: self.lm.sim_decode_block(
+                    self.block_steps, self._tok, self._active, done_in,
+                    self._gen_counts, all_rids))
+            rec = {"toks": toks, "rids": rids, "block": self.blocks,
+                   "end_done": self._sim_end_done(toks, done_in)}
+        else:
+            fused = self.lm.compile_session_decode_fused(
+                self.block_steps, self.slot_sampler, self.pad_token_id)
+            # every host mirror is COPIED before it becomes a device input:
+            # jax's CPU client zero-copy-aliases numpy buffers, and unlike
+            # the sync loop (whose immediate fetch forces execution first)
+            # this program is still in flight when the next scheduling pass
+            # mutates the mirrors in place — the copy gives the program a
+            # buffer only it owns
+            if prev is None:
+                tok_in = jnp.asarray(self._tok[:, None].copy())
+                done_in = jnp.asarray(self._done.copy())
+                gstate_in = (jnp.asarray(self._gstate.copy())
+                             if self.grammar else None)
+            else:
+                tok_in = prev["nxt"]
+                done_in = prev["done"]
+                gstate_in = prev["gstate"]
+                budget = self._budget_done()
+                if budget.any():
+                    done_in = done_in | jnp.asarray(budget)
+            for slot, ov in self._staged.items():
+                if ov is None:
+                    # host-known row (adoption / replay / settled first):
+                    # the mirrors carry the exact values
+                    t_v = int(self._tok[slot])
+                    d_v = bool(self._done[slot])
+                    g_v = int(self._gstate[slot])
+                else:
+                    # deferred first token: still a device future — gather
+                    # the scalar and derive done/DFA-state on device (the
+                    # same latches the sync insert computed on the host)
+                    t_v = ov["fut"][ov["idx"]]
+                    req = self.slots[slot]
+                    d_v = bool(req is not None and req.max_new_tokens <= 1)
+                    eos = int(self._eos[slot])
+                    if eos >= 0:
+                        d_v = (t_v == eos) | d_v
+                    g_v = 0
+                    gi = int(self._gidx[slot])
+                    if self.grammar and gi > 0:
+                        tree = self.session.grammars.tree
+                        g_v = tree["next"][gi, 0, t_v]
+                        d_v = tree["terminal"][gi, g_v] | d_v
+                tok_in = tok_in.at[slot, 0].set(t_v)
+                done_in = done_in.at[slot].set(d_v)
+                if gstate_in is not None:
+                    gstate_in = gstate_in.at[slot].set(g_v)
+            args = (self.lm.params, self.session.cache, tok_in,
+                    self._slot_keys, jnp.asarray(self._gen_counts.copy()),
+                    jnp.asarray(self._lengths.copy()),
+                    jnp.asarray(self._active.copy()),
+                    done_in, jnp.asarray(self._eos.copy()),
+                    jnp.asarray(self._temp.copy()),
+                    jnp.asarray(self._greedy.copy()),
+                    *self.lm._ad_args(self.session.adapters,
+                                      self._adapter_idx.copy()),
+                    *self.lm._gr_args(self.session.grammars,
+                                      self._gidx.copy(),
+                                      gstate_in if gstate_in is not None
+                                      else self._gstate.copy(),
+                                      self._gbudget.copy()))
+            outs = self._dispatch("decode", lambda: fused(*args))
+            self.session.cache = outs[1]
+            rec = {"toks": outs[0], "nxt": outs[2], "done": outs[4],
+                   "gstate": outs[5] if self.grammar else None,
+                   "rids": rids, "block": self.blocks}
+        self._staged.clear()
+        # the device increments lengths/counts unconditionally for every
+        # row — mirror that NOW (a later admission overwrites its slot,
+        # same as sync); the harvest must not advance them again
+        self._lengths += self.block_steps
+        self._gen_counts += self.block_steps
+        self.session.lengths = self.session.lengths + self.block_steps
+        self.stats["program_calls"] += 1
+        self._inflight.append(rec)
+
+    def _harvest_inflight(self, drain: bool = False) -> None:
+        """Fetch+record pipelined blocks down to depth 1 (``drain`` empties
+        the pipeline — the designated-sync-point path). Deferred first
+        tokens settle in stream order: before the first block that includes
+        their row, after the blocks that precede their admission."""
+        keep = 0 if drain else 1
+        while len(self._inflight) > keep:
+            rec = self._inflight.popleft()
+            self._settle_firsts(before_block=rec["block"])
+            self._harvest_rec(rec)
+        self._settle_firsts()
+
+    def _harvest_rec(self, rec: dict) -> None:
+        """Record one fetched block's emissions — the pipelined twin of the
+        sync loop's harvest. Each row is gated on the request id captured
+        at DISPATCH time: a slot retired and re-admitted while the block
+        was in flight must not have the old row's emissions attributed to
+        its new occupant. The live done-latch gate discards a finished
+        row's over-produced tokens, exactly like sync's mid-block
+        post-EOS discard."""
+        toks = self._fetch(rec["toks"], block=rec["block"])
+        self.stats["host_fetches"] += 1
+        now = time.perf_counter()
+        rids = rec["rids"]
+        for i in range(toks.shape[0]):
+            row = toks[i]
+            for slot, req in enumerate(self.slots):
+                if (req is not None and rids[slot] == req.request_id
+                        and not self._done[slot]):
+                    self._record(slot, int(row[slot]), now,
+                                 block=rec["block"])
+                    self._advance_grammar(slot, int(row[slot]))
+        for slot, req in enumerate(self.slots):
+            if req is not None and rids[slot] == req.request_id:
+                self._tok[slot] = int(toks[-1, slot])
+
+    def _settle_firsts(self, before_block: Optional[int] = None) -> None:
+        """Record deferred first tokens (sim: host-known values whose
+        RECORD waited for schedule parity; real: device futures from the
+        admission-time sampler, fetched here — after the previous block's
+        harvest, while the current block still runs). ``before_block``
+        limits the pass to admissions at or before that block — a multi-
+        block drain must interleave first-token records with the blocks
+        that follow them, or a stream's token 0 would land after its
+        token 1."""
+        if not self._first_pending:
+            return
+        keep: List[dict] = []
+        now = time.perf_counter()
+        for p in self._first_pending:
+            if before_block is not None and p["block"] > before_block:
+                keep.append(p)
+                continue
+            tok = (int(p["val"]) if p["fut"] is None
+                   else int(np.asarray(p["fut"])[p["idx"]]))
+            slot = p["slot"]
+            req = self.slots[slot]
+            if req is None or req.request_id != p["rid"]:
+                continue        # cancelled/expired before delivery
+            self._tok[slot] = tok
+            self._record(slot, tok, now, block=p["block"])
+            self._advance_grammar(slot, tok)
+        self._first_pending = keep
+
+    def _flush(self) -> None:
+        """Drain the pipeline completely: fetch+harvest every in-flight
+        block and settle every deferred first token. After a flush the next
+        dispatch restarts cold from the host mirrors — bit-identical state
+        to a sync engine at the same block boundary (which is why snapshot,
+        cancel, replay, corruption recovery and deadline expiry may run
+        their sync-era logic unchanged after calling this)."""
+        self._harvest_inflight(drain=True)
 
     # --- observability surface -------------------------------------------
 
@@ -3368,6 +3831,46 @@ def per_tenant_report(completions: List[Completion],
     return out
 
 
+def interblock_gap_report(tracer: "Tracer", lanes: List[Any]) -> dict:
+    """Summarise the dispatch-side pipeline health across one or more
+    engine lanes (ROADMAP #22). Two distinct idle surfaces come out of the
+    same dispatch/fetch spans:
+
+    - ``interblock_gap_ms_*``: fetch(t) end -> dispatch(t+1) start — time
+      the DEVICE sat idle while the host ran the scheduling pass. This is
+      the number the async loop drives to ~0 (dispatch t+1 precedes
+      fetch t, so the gap is 0 by construction).
+    - ``fetch_blocked_ms_*``: the fetch span itself — time the HOST sat
+      blocked waiting on the device. Sync pays scheduling + fetch serially;
+      async pays only the residue of whatever device work the overlapped
+      scheduling pass didn't cover.
+
+    Returns ``{}`` when no paired spans exist (untraced engines, sim-only
+    runs with < 2 decode blocks).
+    """
+    gaps: List[float] = []
+    blocked: List[float] = []
+    for lane in lanes:
+        g, b = interblock_gaps(tracer, lane)
+        gaps.extend(g)
+        blocked.extend(b)
+    if not gaps and not blocked:
+        return {}
+    out: dict = {}
+    if gaps:
+        out.update({
+            "interblock_gap_ms_p50": round(float(np.percentile(gaps, 50)), 3),
+            "interblock_gap_ms_p99": round(float(np.percentile(gaps, 99)), 3),
+            "interblock_gap_ms_mean": round(float(np.mean(gaps)), 3),
+        })
+    if blocked:
+        out.update({
+            "fetch_blocked_ms_p50": round(float(np.percentile(blocked, 50)), 3),
+            "fetch_blocked_ms_mean": round(float(np.mean(blocked)), 3),
+        })
+    return out
+
+
 def run_trace(engine: ServeEngine, trace: List[dict],
               max_blocks: Optional[int] = None,
               snapshot_path: Optional[str] = None) -> dict:
@@ -3467,6 +3970,11 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         "host_ops_per_block": round(
             (engine.stats["program_calls"] + engine.stats["host_fetches"])
             / decode_blocks, 2),
+        # pipeline surface: device idle between blocks (the async loop's
+        # target metric) and host time blocked in fetches — see
+        # interblock_gap_report for the span pairing
+        "async_loop": engine.async_loop,
+        **interblock_gap_report(engine.tracer, [engine.lane]),
         "queue_blocks_mean": round(float(np.mean(
             [c.queue_blocks for c in completions])), 2) if completions else None,
         "decode_blocks_mean": round(float(np.mean(

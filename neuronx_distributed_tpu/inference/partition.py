@@ -188,6 +188,38 @@ def shard_avals(avals: PyTree) -> PyTree:
     return jax.tree_util.tree_map_with_path(pin, avals)
 
 
+def repl_args(*args: Any) -> tuple:
+    """Commit each (concrete) lowering example array fully REPLICATED —
+    identity off-mesh. The row-state inputs of the fused session decode
+    ((b,) control vectors, (b,1) tok, (b,) key rows) must not be left
+    unannotated at ``lower`` time: GSPMD otherwise assigns them its own
+    layout (observed: batch over 'edp' whenever max_batch divides it),
+    which the ASYNC block loop — the one caller that feeds these slots
+    COMMITTED arrays (block t's outputs, staged-override edits) — then
+    trips at call time. Replicated in + replicated out (``replicate_out``
+    on the row outputs) keeps the t→t+1 feedback loop sharding-stable."""
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    if not ps.model_parallel_is_initialized():
+        return args
+    repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+    return tuple(jax.device_put(a, repl) for a in args)
+
+
+def repl_avals(avals: PyTree) -> PyTree:
+    """``shard_avals``'s replicated counterpart for row-state
+    ``ShapeDtypeStruct`` trees (the (rows,) adapter/grammar index vectors
+    riding the session programs) — identity off-mesh."""
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    if not ps.model_parallel_is_initialized():
+        return avals
+    repl = NamedSharding(ps.get_mesh(), PartitionSpec())
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
+        avals)
+
+
 def zeros_like_avals(avals: PyTree) -> PyTree:
     """All-zeros tree materialized WITH each aval's sharding (fresh
     session caches / identity pools must be born in the layout the AOT

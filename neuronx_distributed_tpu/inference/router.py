@@ -95,6 +95,7 @@ from neuronx_distributed_tpu.inference.engine import (
     ReplicaLoad,
     Request,
     ServeEngine,
+    interblock_gap_report,
     per_tenant_report,
 )
 from neuronx_distributed_tpu.inference.faults import FaultInjector, FaultPlan
@@ -1461,6 +1462,13 @@ def run_router_trace(router: Router, trace,
         "ttft_blocks_mean": round(float(np.mean(
             [c.ttft_blocks for c in completions])), 2)
         if completions else None,
+        # pipeline surface aggregated over every replica lane that ever
+        # dispatched (parked replicas contribute no spans)
+        "async_loop": any(getattr(e, "async_loop", False)
+                          for e in router.engines if e is not None),
+        **interblock_gap_report(
+            router.tracer,
+            [e.lane for e in router.engines if e is not None]),
         # provisioned capacity actually consumed (replica-blocks): the
         # denominator of the autoscale-vs-fixed goodput-per-capacity key
         "replica_blocks": router.stats["replica_blocks"],

@@ -143,7 +143,17 @@ class SimCausalLM:
         """One K-step decode block for the whole pool, pure numpy: the
         emitted (K, max_batch) token matrix (pad for inactive/frozen
         slots — the engine's host mirror latches done exactly as it does
-        for the fused device scan)."""
+        for the fused device scan).
+
+        ASYNC LOOP (``ServeEngine(async_loop=True)``): the sim "dispatch"
+        stays eager — the matrix is host-known immediately — but the
+        engine still queues it as an in-flight record and defers every
+        RECORD to the pipelined harvest one iteration later, feeding this
+        function the ``done`` input the real device would have carried out
+        of the previous block (``ServeEngine._sim_end_done``). That is
+        what keeps a sim soak's admission/retire schedule bit-identical
+        to a real async engine's, so the sim-vs-real schedule pins of
+        ``tests/test_sched_perf.py`` extend to the pipelined loop."""
         out = np.zeros((int(steps), self.max_batch), np.int64)
         idx = np.arange(int(steps), dtype=np.int64)
         for s in range(self.max_batch):

@@ -224,6 +224,40 @@ class Tracer:
         return doc
 
 
+def interblock_gaps(tracer: Tracer, lane_track: Any) -> Tuple[List[float], List[float]]:
+    """Inter-block device-idle gaps and host-blocked fetch times, in ms,
+    read off the ``(lane, "dispatch")`` track's existing ``decode``/``fetch``
+    X spans — no new instrumentation.
+
+    The i-th gap pairs the i-th ``fetch`` span (host comes back from the
+    blocking ``np.asarray``) with the (i+1)-th ``decode`` span (the next
+    fused-block dispatch): ``gap = max(0, dispatch.ts - fetch.end)``. Under
+    the synchronous loop the whole scheduling pass sits in that window and
+    the device idles through it; under ``async_loop`` block t+1 is
+    dispatched BEFORE block t's fetch, the pairing goes negative, and the
+    clamped gap is exactly 0.0 — which is what the zero-host-blocking
+    contract test asserts. The second list is each fetch's own duration
+    (the host-blocked side of the split): in the async loop it overlaps
+    device compute instead of following it.
+
+    Pure stdlib on recorded host events (this module must stay importable
+    without numpy/jax); percentile math happens at the call sites.
+    """
+    lane = (lane_track, "dispatch")
+    decodes = [ev for ev in tracer.events("decode")
+               if ev["ph"] == "X" and ev["lane"] == lane]
+    fetches = [ev for ev in tracer.events("fetch")
+               if ev["ph"] == "X" and ev["lane"] == lane]
+    gaps: List[float] = []
+    for i, f in enumerate(fetches):
+        if i + 1 >= len(decodes):
+            break
+        d = decodes[i + 1]
+        gaps.append(max(0.0, (d["ts"] - (f["ts"] + f["dur"])) * 1e3))
+    blocked = [f["dur"] * 1e3 for f in fetches]
+    return gaps, blocked
+
+
 def validate_chrome_trace(doc: dict, require_request_lanes: bool = True) -> dict:
     """Schema gate for an exported trace (the tier-1 smoke and the
     lifecycle-coverage test run every exported file through this). Checks:

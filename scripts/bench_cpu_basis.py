@@ -59,6 +59,15 @@ tiny-dims model and re-measures just ``bench.bench_paged_kernel``:
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
         --kernel-update BENCH_r09.json BENCH_r10.json
+
+Async-block-loop refresh (ISSUE 19): the two async HEADLINE keys
+(``serve_interblock_gap_ms``, ``serve_tokens_per_sec_async_smallK``)
+postdate every committed artifact, so ``--async-update`` builds one
+tiny-dims model and re-measures just ``bench.bench_async_loop`` (which
+also records the sync bases the >= 2x gap pin divides against):
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --async-update BENCH_r10.json BENCH_r11.json
 """
 
 from __future__ import annotations
@@ -234,6 +243,72 @@ def _kernel_update(base_path: str, out_path: str) -> int:
     return 0
 
 
+def _async_update(base_path: str, out_path: str) -> int:
+    """BENCH_r(x+1) = BENCH_rx + freshly measured async-block-loop keys
+    (ISSUE 19: the pipelined loop postdates every committed serving
+    artifact — without this refresh bench_regress would report the two
+    new HEADLINE keys as new_key forever and the >= 2x inter-block-gap
+    pin would have no committed sync basis to divide against). Builds
+    ONE tiny-dims model and runs just bench.bench_async_loop over it —
+    the same CPU basis (and the same dims) as the carried-over
+    sections; the section runs at its own small fused_steps=4."""
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models.llama import (LlamaConfig,
+                                                      LlamaForCausalLM)
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, neuronx_distributed_config,
+    )
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+
+    prompt_len, max_batch = 128, 4
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    cfg = neuronx_distributed_config(tensor_parallel_size=1)
+    lcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=prompt_len + 256, dtype=jnp.float32,
+        param_dtype=jnp.float32, use_flash_attention=False,
+        remat_policy=None)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg),
+                                      ids)
+    sec = bench.bench_async_loop(lcfg, model.params,
+                                 prompt_len=prompt_len,
+                                 max_batch=max_batch)
+    parsed.update(sec)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | async-block-loop keys measured by --async-update "
+        + "(fused_steps=4, streams checked bit-identical to the sync "
+        + "oracle inline) on top of " + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--async-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in sec if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _tp_update(base_path: str, out_path: str) -> int:
     """BENCH_r0(x+1) = BENCH_r0x + freshly measured TP-sharded-serving
     keys (ISSUE 16: the keys need >= 2 devices, which no committed
@@ -302,6 +377,8 @@ def main() -> int:
         return _tp_update(sys.argv[2], sys.argv[3])
     if len(sys.argv) >= 4 and sys.argv[1] == "--kernel-update":
         return _kernel_update(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 4 and sys.argv[1] == "--async-update":
+        return _async_update(sys.argv[2], sys.argv[3])
 
     import jax.numpy as jnp
 

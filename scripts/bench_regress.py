@@ -59,9 +59,13 @@ import sys
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-# ordered (pattern, direction, rel_tol) — first match wins. Patterns are
-# full-match regexes over the key name.
-RULES: List[Tuple[str, str, float]] = [
+# ordered (pattern, direction, rel_tol[, abs_tol]) — first match wins.
+# Patterns are full-match regexes over the key name. The optional 4th
+# element is an ABSOLUTE floor for zero-baseline keys: a relative
+# tolerance can never trip when the committed baseline is exactly 0.0
+# (the async inter-block gap is zero BY CONSTRUCTION), so a lower-better
+# key with abs_tol regresses whenever the candidate exceeds it.
+RULES: List[tuple] = [
     # explicit ratios whose direction the name alone cannot tell
     (r"serve_tracing_overhead_ratio", "higher", 0.03),
     (r"serve_goodput_2x_vs_1x", "higher", 0.10),
@@ -110,6 +114,14 @@ RULES: List[Tuple[str, str, float]] = [
     (r"serve_tokens_per_sec_paged_kernel", "higher", 0.10),
     (r"paged_hbm_bytes_vs_slab_int8", "lower", 0.10),
     (r"serve_greedy_match_rate_int8kv", "higher", 0.0),
+    # async double-buffered block loop (ISSUE 19): the inter-block device
+    # idle is ~0 by construction when pipelined, so any positive drift is
+    # a pipeline break — but the value is wall-clock on a shared box, so
+    # the tolerance is generous in RELATIVE terms while the absolute
+    # number stays near zero; async small-K throughput gates like every
+    # tok/s key (named explicitly so its intent survives pattern shifts)
+    (r"serve_interblock_gap_ms", "lower", 0.50, 5.0),
+    (r"serve_tokens_per_sec_async_smallK", "higher", 0.10),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better
@@ -134,11 +146,12 @@ _SALVAGE_RE = re.compile(
     r"\s*[,}]")
 
 
-def classify(key: str) -> Tuple[Optional[str], float]:
-    for pat, direction, tol in RULES:
+def classify(key: str) -> Tuple[Optional[str], float, Optional[float]]:
+    for rule in RULES:
+        pat, direction, tol = rule[0], rule[1], rule[2]
         if re.fullmatch(pat, key):
-            return direction, tol
-    return None, 0.0
+            return direction, tol, (rule[3] if len(rule) > 3 else None)
+    return None, 0.0, None
 
 
 def salvage_tail(tail: str) -> Dict[str, float]:
@@ -216,11 +229,20 @@ def compare(base: Dict[str, float], cand: Dict[str, float],
                          "gated": key in gated_set})
             continue
         b, c = base[key], cand[key]
-        direction, tol = classify(key)
+        direction, tol, abs_tol = classify(key)
         tol = tol_overrides.get(key, tol) * tol_scale
         if abs(b) < 1e-12:
+            # a relative tolerance is meaningless off a zero baseline;
+            # keys that declare an absolute floor still gate (lower-better:
+            # any candidate above the floor is a regression — the async
+            # inter-block gap regrowing from its by-construction 0.0)
             rel = None
-            verdict = "info"
+            if abs_tol is None or direction is None:
+                verdict = "info"
+            elif direction == "lower":
+                verdict = "regressed" if c > abs_tol * tol_scale else "ok"
+            else:
+                verdict = "improved" if c > abs_tol * tol_scale else "ok"
         else:
             rel = (c - b) / abs(b)
             if direction is None:

@@ -15,7 +15,15 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Tier-1 budget: the thunk runtime (default since jaxlib 0.4.32) compiles
+# each tiny program noticeably slower than the classic CPU runtime and the
+# suite is compile-dominated — ~15-45% wall clock per file. Outcome-neutral
+# for the same reason as jax_disable_most_optimizations below: every
+# exactness test compares two programs compiled under the SAME flags.
+if "xla_cpu_use_thunk_runtime" not in _flags:
+    _flags = (_flags + " --xla_cpu_use_thunk_runtime=false").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 # This image's sitecustomize registers a TPU PJRT plugin and imports jax at
 # interpreter start, so the env var alone is too late — switch via config too.
@@ -28,6 +36,11 @@ jax.config.update("jax_platforms", "cpu")
 # compiled under the SAME flags, so the equality claims are unaffected.
 # bench.py runs outside pytest and keeps full optimization.
 jax.config.update("jax_disable_most_optimizations", True)
+# NOTE: do NOT enable the persistent compilation cache here
+# (jax_compilation_cache_dir): on this jaxlib a cache-hit executable reused
+# after destroy_model_parallel()/rebuild (the autouse fixture below does
+# that between every test) segfaults in the CPU client — the reused
+# executable holds device state from the torn-down mesh.
 
 import pytest  # noqa: E402
 

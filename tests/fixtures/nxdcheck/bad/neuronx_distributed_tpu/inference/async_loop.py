@@ -1,0 +1,28 @@
+"""Known-bad fixture for async-contract: a pipelined step that blocks
+the host between dispatches — every primitive the rule fences off,
+called directly from async-named functions."""
+
+import time
+
+import numpy as np
+
+
+class ServeEngine:
+    def _step_block_async(self):
+        self._dispatch_block_async()
+        # fetches the block it JUST dispatched: the pipeline collapses
+        # back into the sync loop
+        toks = np.asarray(self._inflight[-1]["toks"])
+        for t in toks.tolist():
+            self._record(t)
+        return True
+
+    def _dispatch_block_async(self):
+        fused = self.lm.compile_session_decode_fused(self.block_steps)
+        outs = self._dispatch("decode", lambda: fused(*self._args()))
+        # direct blocking fetch between dispatches instead of deferring
+        # to the harvest helpers
+        nxt = self._fetch(outs[2])
+        self._tok = nxt.item()
+        time.sleep(0.001)
+        self._inflight.append({"toks": outs[0]})

@@ -856,6 +856,97 @@ def test_bench_regress_committed_r10_gates_kernel_keys(tmp_path):
         [r["key"] for r in summary["regressions"]]
 
 
+def test_bench_regress_committed_r11_gates_async_keys(tmp_path):
+    """ISSUE 19 satellite: BENCH_r11 (scripts/bench_cpu_basis.py
+    --async-update over r10) carries the async-block-loop keys no prior
+    artifact could. Self-pass, r10 -> r11 lands them as new_key, and the
+    committed values meet the acceptance bars: the inter-block gap drops
+    >= 2x vs the sync sidecar basis, the async loop GAINS throughput at
+    small fused K, and the streams-exact sidecar (async == sync
+    bit-identity, asserted inside the bench itself) is True."""
+    doc = json.loads((REPO / "BENCH_r11.json").read_text())
+    assert doc["rc"] == 0 and "--async-update" in doc["cmd"]
+    p = doc["parsed"]
+    for key in ("serve_interblock_gap_ms", "serve_interblock_gap_ms_sync",
+                "serve_tokens_per_sec_async_smallK",
+                "serve_tokens_per_sec_sync_smallK",
+                "serve_async_streams_exact"):
+        assert key in p, key
+    assert not [k for k in p if k.endswith("_error")], "a section failed"
+    # the acceptance criteria, pinned on the committed artifact
+    assert p["serve_async_streams_exact"] is True
+    assert p["serve_interblock_gap_ms_sync"] > 0.0
+    assert p["serve_interblock_gap_ms"] <= \
+        0.5 * p["serve_interblock_gap_ms_sync"], \
+        "ISSUE 19 bar: gap must drop >= 2x vs sync"
+    assert p["serve_tokens_per_sec_async_smallK"] > \
+        p["serve_tokens_per_sec_sync_smallK"]
+    rc, summary, err = _regress(REPO / "BENCH_r11.json",
+                                REPO / "BENCH_r11.json")
+    assert rc == 0, err
+    assert summary["verdict"] == "pass"
+    rc, summary, _ = _regress(REPO / "BENCH_r10.json",
+                              REPO / "BENCH_r11.json")
+    assert rc == 0, "new async keys must land as new_key over r10"
+    # a regrown gap gates: the headline key is lower-better at 50% tol
+    bad = dict(doc, parsed=dict(
+        p, serve_interblock_gap_ms=p["serve_interblock_gap_ms_sync"]))
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    rc, summary, _ = _regress(REPO / "BENCH_r11.json", tmp_path / "bad.json")
+    assert rc == 1
+    assert "serve_interblock_gap_ms" in \
+        [r["key"] for r in summary["regressions"]]
+
+
+def test_bench_regress_async_direction_rules(tmp_path):
+    """Direction-of-goodness for the async-loop keys: a RISING inter-block
+    gap regresses (lower-better, 50% tolerance — the committed value is
+    ~0, so any real regrowth trips it), and FALLING small-K throughput
+    regresses beyond the usual 10%."""
+    keys = ["serve_interblock_gap_ms", "serve_tokens_per_sec_async_smallK"]
+    base = {"headline_keys": keys, "serve_interblock_gap_ms": 1.0,
+            "serve_tokens_per_sec_async_smallK": 250.0}
+    gap = {"headline_keys": keys, "serve_interblock_gap_ms": 40.0,
+           "serve_tokens_per_sec_async_smallK": 250.0}
+    slow = {"headline_keys": keys, "serve_interblock_gap_ms": 1.0,
+            "serve_tokens_per_sec_async_smallK": 180.0}
+    better = {"headline_keys": keys, "serve_interblock_gap_ms": 0.1,
+              "serve_tokens_per_sec_async_smallK": 300.0}
+    for name, doc in (("base", base), ("gap", gap), ("slow", slow),
+                      ("better", better)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "gap.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "serve_interblock_gap_ms"
+    assert summary["regressions"][0]["direction"] == "lower"
+    rc, summary, _ = _regress(tmp_path / "base.json", tmp_path / "slow.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == \
+        "serve_tokens_per_sec_async_smallK"
+    assert summary["regressions"][0]["direction"] == "higher"
+    rc, summary, _ = _regress(tmp_path / "base.json",
+                              tmp_path / "better.json")
+    assert rc == 0 and summary["counts"]["improved"] == 2
+    # the zero-baseline absolute floor: the committed gap is EXACTLY 0.0
+    # (by construction), where a relative tolerance can never trip — the
+    # rule's abs_tol still gates any real regrowth, while sub-floor
+    # wall-clock jitter stays ok
+    zero = {"headline_keys": keys, "serve_interblock_gap_ms": 0.0,
+            "serve_tokens_per_sec_async_smallK": 250.0}
+    regrown = dict(zero, serve_interblock_gap_ms=40.0)
+    jitter = dict(zero, serve_interblock_gap_ms=0.5)
+    for name, doc in (("zero", zero), ("regrown", regrown),
+                      ("jitter", jitter)):
+        (tmp_path / f"{name}.json").write_text(json.dumps(doc))
+    rc, summary, _ = _regress(tmp_path / "zero.json",
+                              tmp_path / "regrown.json")
+    assert rc == 1
+    assert summary["regressions"][0]["key"] == "serve_interblock_gap_ms"
+    rc, summary, _ = _regress(tmp_path / "zero.json",
+                              tmp_path / "jitter.json")
+    assert rc == 0, "sub-floor jitter off a zero baseline must not gate"
+
+
 def test_bench_regress_autoscale_direction_rules(tmp_path):
     """Direction-of-goodness for the autoscale keys: a FALLING
     goodput-per-capacity ratio or a RISING time-to-ready regresses; the

@@ -157,6 +157,30 @@ def test_inference_runner_serve_tiny(capsys):
     assert report["tokens_per_sec"] > 0
 
 
+def test_inference_runner_serve_async_tiny(capsys):
+    """ISSUE 19 CI gate: runner.py serve --async drives the pipelined
+    double-buffered block loop — requests complete with the same token
+    totals as the sync smoke, the dispatch contract holds (dispatch at
+    iteration t, fetch of block t-1 pipelined behind it — still 2 host
+    ops per block), the report says async_loop, and the inter-block gap
+    keys ride the report with the async gap pinned at ~0 (the
+    zero-host-blocking-between-blocks contract, measured)."""
+    import runner
+
+    runner.main(["serve", "--tiny", "--async", "--max_batch", "2",
+                 "--num_requests", "4", "--max_new_tokens", "6",
+                 "--fused_steps", "3"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 6
+    assert report["fused"] is True and report["async_loop"] is True
+    assert report["host_ops_per_block"] == 2.0
+    assert report["tokens_per_sec"] > 0
+    # the pipelined loop's defining number: dispatch t+1 precedes fetch t,
+    # so the measured device idle between blocks is exactly zero
+    assert report["interblock_gap_ms_mean"] == 0.0
+
+
 def test_inference_runner_serve_paged_tiny(capsys):
     """ISSUE 3 CI gate: runner.py serve --paged drives the paged KV engine
     (page_size 4 forces multi-page prompts at tiny scale) over a shared-

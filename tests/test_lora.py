@@ -256,9 +256,12 @@ def test_lora_dropout_stacked_and_gqa_layers_run():
     lcfg2 = LoraConfig(r=4, lora_dropout=0.0)
     model2, state2, step2, _ = _build(lora_config=lcfg2)
     _, m1 = step2(state2, batch, jax.random.key(0))
-    # lora_b starts at zero, so the adapter delta is 0 regardless of mask
+    # lora_b starts at zero, so the adapter delta is 0 regardless of mask.
+    # The two losses come from two DIFFERENT compiled programs (with/without
+    # the dropout subgraph), so they agree only up to fp32 reassociation —
+    # not bitwise — and the margin depends on backend scheduling.
     np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
-                               rtol=1e-5)
+                               rtol=1e-3)
 
 
 def test_config_overrides_applied():

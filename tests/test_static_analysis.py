@@ -25,7 +25,7 @@ FIXTURES = REPO / "tests" / "fixtures" / "nxdcheck"
 WAIVERS = REPO / "neuronx_distributed_tpu" / "analysis" / "waivers.txt"
 
 RULE_IDS = ("host-sync", "cache-replication", "resource-pairing",
-            "determinism", "surface-drift")
+            "determinism", "surface-drift", "async-contract")
 
 
 def _run(root, rules=ALL_RULES, waivers=None):
@@ -86,6 +86,7 @@ def test_bad_fixture_finding_shapes():
         ("surface-drift", "faults.py"),
         ("surface-drift", "test_surface.py"),
         ("surface-drift", "BENCH_r01.json"),
+        ("async-contract", "async_loop.py"),
     }
     missing = expect - got
     assert not missing, f"expected finding classes absent: {missing}"
@@ -95,7 +96,8 @@ def test_bad_fixture_finding_shapes():
                    "storm", "*_pins map", "bare-set iteration",
                    "wall-clock", "unseeded", "ghost_ratio",
                    "dead_knob_prob", "ghost_key", "ghost_event",
-                   "retired_key", "serve_thing_ms", "no producing store"):
+                   "retired_key", "serve_thing_ms", "no producing store",
+                   "pipelined dispatch path", "harvest helpers"):
         assert needle in msgs, f"missing defect class: {needle}"
 
 

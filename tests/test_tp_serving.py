@@ -186,6 +186,47 @@ def test_tp2_disagg_handoff_adopt_exact():
     assert router.stats["handoffs_degraded"] == 0
 
 
+def test_tp2_async_loop_edp_divisible_batch_exact():
+    """The async block loop on the mesh at the GSPMD-pitfall config:
+    max_batch=4 divides the 8-device mesh's 'edp' axis, so unannotated
+    row inputs would let the compiler pick an edp-sharded layout — the
+    sync loop's uncommitted host arrays auto-reshard and pass BY LUCK,
+    but the async loop feeds block t+1 COMMITTED values (block t's
+    outputs, staged-override edits) and trips at dispatch. repl_args/
+    repl_avals pin the fused program's row inputs replicated and
+    replicate_out pins the row outputs, so the t->t+1 feedback loop is
+    sharding-stable; streams stay bit-identical to the sync oracle."""
+    _world(2)
+    cfg = LlamaConfig(**TINY)
+    nxd = neuronx_distributed_config(tensor_parallel_size=2)
+    model = initialize_parallel_model(
+        nxd, lambda: LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+    lm = CausalLM(cfg, model.params, LlamaForCausalLM, buckets=(8, 16),
+                  max_batch=4, page_size=PAGE).compile()
+    p = _prompts(5, seed=7)
+    submits = [dict(prompt=p[0], max_new_tokens=9),
+               dict(prompt=p[1], max_new_tokens=7, arrival_block=1,
+                    sampler=Sampler(temperature=0.8)),
+               dict(prompt=p[2], max_new_tokens=11, eos_token_id=7,
+                    arrival_block=2),
+               dict(prompt=p[3], max_new_tokens=6, arrival_block=3,
+                    sampler=Sampler(temperature=1.3)),
+               dict(prompt=p[4], max_new_tokens=8, arrival_block=4)]
+
+    def drive(async_loop):
+        eng = ServeEngine(lm, block_steps=K, rng=jax.random.key(42),
+                          async_loop=async_loop)
+        for kw in submits:
+            eng.submit(**kw)
+        eng.run()
+        return {c.request_id: (c.tokens.tolist(), c.finish_reason)
+                for c in eng.completed}
+
+    sync = drive(False)
+    assert drive(True) == sync
+    assert len(sync) == 5
+
+
 # ------------------------------------------------ the spec layer itself
 
 def test_partition_spec_derivation():

@@ -552,6 +552,8 @@ def cmd_serve(args) -> None:
                   max_queue=args.max_queue, shed_policy=args.shed_policy,
                   block_time_ms=args.block_time_ms,
                   host_tier_pages=tier_pages,
+                  park_idle_blocks=args.park_idle_blocks,
+                  park_dir=args.park_dir,
                   slos=slos,
                   # the incident trace slice reads the tracer, so arming
                   # the flight recorder turns structured tracing on too
@@ -961,6 +963,22 @@ def main(argv=None) -> None:
                             "drain; if it EXISTS at startup the previous "
                             "run's in-flight streams are restored and "
                             "finished bit-identical")
+        p.add_argument("--park-idle-blocks", "--park_idle_blocks",
+                       dest="park_idle_blocks", type=int, default=0,
+                       help="serve: park a conversation whose stream has "
+                            "been idle (no decode progress) for this many "
+                            "blocks — its KV pages and engine state move "
+                            "to the durable tier at --park-dir and it "
+                            "vacates device AND host entirely; resume via "
+                            "submit(resume=...) continues bit-identical "
+                            "without re-prefill. 0 = explicit park() only")
+        p.add_argument("--park-dir", "--park_dir",
+                       dest="park_dir", type=str, default=None,
+                       help="serve: directory for the durable conversation "
+                            "tier (crash-consistent per-conversation "
+                            "manifests; torn writes from a SIGKILL are "
+                            "quarantined on the next open, never served). "
+                            "Required when --park-idle-blocks > 0")
         p.add_argument("--replicas", type=int, default=1,
                        help="serve: N>1 drives N ServeEngine replicas "
                             "behind the Router front door (prefix-affinity "

@@ -44,10 +44,12 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 from .core import Finding, FileCtx, RepoCtx, Rule
 
 TARGET_FILES = ("inference/engine.py", "inference/router.py",
-                "inference/disagg.py", "inference/causal_lm.py")
+                "inference/disagg.py", "inference/causal_lm.py",
+                "inference/conversation_tier.py")
 
 RELEASE_METHOD = re.compile(r"^_release_([a-z_]+)$")
-SEAMISH = re.compile(r"shed|cancel|expire|extract|retire|abort|handoff")
+SEAMISH = re.compile(r"shed|cancel|expire|extract|retire|abort|handoff"
+                     r"|park|resume")
 
 PAGE_ACQUIRE = {"plan", "begin_chunked"}
 PAGE_RELEASE = {"rollback", "abort_chunked", "commit", "finish_chunked",

@@ -42,6 +42,9 @@ class BaseCheckpointStorage:
     def save_text(self, text: str, path: str) -> None:
         raise NotImplementedError
 
+    def save_bytes(self, data: bytes, path: str) -> None:
+        raise NotImplementedError
+
     def load_text(self, path: str) -> str:
         raise NotImplementedError
 
@@ -86,6 +89,14 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
         with open(tmp, "w") as f:
             f.write(text)
         os.replace(tmp, p)  # atomic marker write
+
+    def save_bytes(self, data: bytes, path: str) -> None:
+        p = self.abspath(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)  # atomic payload write
 
     def load_text(self, path: str) -> str:
         with open(self.abspath(path)) as f:
@@ -204,6 +215,10 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
     def save_text(self, text: str, path: str) -> None:
         self._retry(
             lambda: self._kv.write(self._key(path), text.encode()).result())
+
+    def save_bytes(self, data: bytes, path: str) -> None:
+        self._retry(
+            lambda: self._kv.write(self._key(path), data).result())
 
     def load_text(self, path: str) -> str:
         r = self._retry(lambda: self._kv.read(self._key(path)).result())

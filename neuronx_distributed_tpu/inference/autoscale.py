@@ -151,6 +151,13 @@ class Autoscaler:
         self._unresolved_ups: set = set()
         self._over: Dict[str, int] = {}
         self._idle: Dict[str, int] = {}
+        # signal-staleness credit latched when an over/idle run STARTS
+        # (PR 19 remainder): under async_loop the state transition that
+        # opened the run was itself observed late, and by the time it is
+        # visible the pipeline may have drained (instantaneous staleness
+        # back to 0) — so the deficit must be remembered for the run
+        self._over_carry: Dict[str, int] = {}
+        self._idle_carry: Dict[str, int] = {}
         self._last_event: Dict[str, int] = {}
         self._parked_seen: set = set()
         # up-event index -> blocks-to-first-placement, resolved eagerly
@@ -260,20 +267,52 @@ class Autoscaler:
             live = [i for i in router._live_replicas()
                     if router.role_of(i) == role]
         sig = self._signals(router, role, live)
-        self._over[role] = self._over.get(role, 0) + 1 if sig.up_reason else 0
+        prev_over = self._over.get(role, 0)
+        self._over[role] = prev_over + 1 if sig.up_reason else 0
         idle = (sig.up_reason is None
                 and sig.utilization < pol.down_utilization)
-        self._idle[role] = self._idle.get(role, 0) + 1 if idle else 0
+        prev_idle = self._idle.get(role, 0)
+        self._idle[role] = prev_idle + 1 if idle else 0
+        # PR 19 remainder: async_loop replicas report load one block stale
+        # (observed_block = the newest block whose device effects the
+        # summary reflects; the in-flight pipeline block lags it).  The
+        # state transition that OPENS an over/idle run was itself observed
+        # that much late, so patience counters measured against stale
+        # signals would fire one block LATER than the sync fleet on the
+        # same trace.  Latch the staleness when the run starts (by the
+        # time the run is several blocks old the pipeline may have drained
+        # and instantaneous staleness read 0 again) and credit it toward
+        # patience so scale events land on the same virtual block either
+        # way.  The policy runs BEFORE this block's step, so the freshest
+        # possible stamp is router.blocks - 1; a sync fleet always
+        # latches 0 and is untouched.
+        stale = 0
+        obs = [router._rload[i].observed_block for i in live
+               if router._rload[i].observed_block]
+        if obs:
+            stale = max(0, int(router.blocks) - 1 - min(obs))
+        if sig.up_reason is not None:
+            if prev_over == 0:
+                self._over_carry[role] = stale
+        else:
+            self._over_carry[role] = 0
+        if idle:
+            if prev_idle == 0:
+                self._idle_carry[role] = stale
+        else:
+            self._idle_carry[role] = 0
         last = self._last_event.get(role)
         cooled = last is None or router.blocks - last >= pol.cooldown_blocks
         draining_role = any(router.role_of(i) == role
                             for i in router._draining)
         if (sig.up_reason is not None and cooled
-                and self._over[role] >= pol.up_patience_blocks
+                and (self._over[role] + self._over_carry.get(role, 0)
+                     >= pol.up_patience_blocks)
                 and len(live) < pol.max_replicas):
             self._scale_up(router, role, pol, sig.up_reason)
         elif (cooled and not draining_role
-                and self._idle[role] >= pol.down_patience_blocks
+                and (self._idle[role] + self._idle_carry.get(role, 0)
+                     >= pol.down_patience_blocks)
                 and len(live) > pol.min_replicas):
             loads = {i: router._rload[i] for i in live}
             victim = min(live, key=lambda i: (

@@ -260,6 +260,15 @@ class ReplicaLoad:
     # instead of re-scanning every replica's slots and queue per shed
     inflight_tokens: int = 0         # sum over live slots of remaining budget
     queued_tokens: int = 0           # sum over queued requests' budgets
+    # the block whose EMISSIONS this summary reflects (PR 19 remainder):
+    # under async_loop the harvest trails the dispatch clock by the
+    # in-flight block, so a router reading the summary at block B sees
+    # counters as of B-1 — the autoscaler compensates its patience with
+    # (router.blocks - observed_block) instead of scaling a block late
+    observed_block: int = 0
+    # conversations this replica holds ONLY as park records (0 device +
+    # 0 host pages): capacity planning reads resident vs parked load
+    parked: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -302,6 +311,9 @@ _STAT_KEYS = (
     "adapter_rejects", "adapter_load_retries",
     "grammar_rejects", "grammar_load_retries",
     "handoffs_sent", "handoffs_adopted",
+    # conversation tier (ROADMAP #21): parks taken, exact resumes, resumes
+    # degraded to the replay path, and resumes refused outright
+    "parked", "resumed", "park_replays", "park_rejects",
     # streaming-report aggregates (ROADMAP #18): the memory-bounded trace
     # drivers (keep_completions=False) read the whole completion surface
     # from these counters + the latency histograms instead of materialized
@@ -406,6 +418,9 @@ class ServeEngine:
         role: str = "both",
         keep_completions: bool = True,
         async_loop: bool = False,
+        park_idle_blocks: int = 0,
+        park_dir: Optional[str] = None,
+        park_store=None,
     ):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(
@@ -452,6 +467,25 @@ class ServeEngine:
         self._sim = bool(getattr(lm, "sim", False))
         if self._sim and host_tier_pages:
             raise ValueError("sim engines have no device pages to tier")
+        # persistent conversation tier (ROADMAP #21): parking exports KV
+        # PAGES, so the paged pool is the park unit — contiguous-slab and
+        # sim engines have nothing exportable below the host tier
+        if park_idle_blocks < 0:
+            raise ValueError(
+                f"park_idle_blocks must be >= 0, got {park_idle_blocks}")
+        if park_idle_blocks or park_dir is not None or park_store is not None:
+            if park_dir is not None and park_store is not None:
+                raise ValueError("pass park_dir OR park_store, not both")
+            if park_dir is None and park_store is None:
+                raise ValueError(
+                    "park_idle_blocks requires park_dir or park_store — "
+                    "the park has to land somewhere durable")
+            if self._sim:
+                raise ValueError("sim engines have no KV pages to park")
+            if not getattr(lm, "paged", False):
+                raise ValueError(
+                    "conversation parking requires a paged CausalLM "
+                    "(KV pages are the park unit)")
         self.lm = lm
         self.block_steps = int(block_steps)
         self.fused = bool(fused)
@@ -532,6 +566,15 @@ class ServeEngine:
         self._m_handoff = self.metrics.histogram(
             "serve_handoff_adopt_ms",
             help="migrated-prompt page adoption wall ms", lo=0.01)
+        # conversation-tier price tags: park (page export + durable write +
+        # eviction) and resume (durable read + verify + page adoption)
+        self._m_park = self.metrics.histogram(
+            "serve_park_ms",
+            help="conversation park (export+store+evict) wall ms", lo=0.01)
+        self._m_park_resume = self.metrics.histogram(
+            "serve_park_resume_ms",
+            help="parked-conversation resume (load+verify+adopt) wall ms",
+            lo=0.01)
         # SLO burn-rate monitor (observability/slo.py): declarative
         # objectives evaluated once per block; None (the default) costs
         # nothing — the monitor is never constructed
@@ -579,6 +622,31 @@ class ServeEngine:
                 # tier seam: seeded restore failures / corrupted tier bytes
                 self.session.paged.tier.fault_hook = \
                     self._injector.on_tier_restore
+        # durable park tier (inference/conversation_tier.py): idle
+        # conversations spill KV pages + request state to the checkpoint
+        # storage backends and evict entirely from device AND host. The
+        # store may be shared fleet-wide (Router passes park_store) so a
+        # conversation parked by a drained/crashed replica resumes anywhere.
+        self.park_idle_blocks = int(park_idle_blocks)
+        self.park_store = None
+        if park_store is not None or park_dir is not None:
+            if park_store is not None:
+                self.park_store = park_store
+            else:
+                from neuronx_distributed_tpu.inference.conversation_tier \
+                    import ConversationParkStore
+                self.park_store = ConversationParkStore(park_dir)
+            if self._injector is not None:
+                # park seam: seeded write failures / torn manifests / read
+                # failures / at-rest bit flips (one draw per operation)
+                self.park_store.write_fault_hook = self._injector.on_park_write
+                self.park_store.read_fault_hook = self._injector.on_park_read
+        # in-process records of parked conversations (request object +
+        # generated tokens + wall stamps): the degradation ladder's last
+        # rung before "unresumable", and the snapshot's parked section
+        self._parked: Dict[int, dict] = {}
+        # rid -> block it (re)entered decode: the idle sweep's clock
+        self._decode_since: Dict[int, int] = {}
         b = lm.max_batch
         # heap-backed admission backlog (inference/schedq.py): EDF order,
         # shed victims, queued-deadline expiry and the arrived/token
@@ -633,6 +701,15 @@ class ServeEngine:
         self._prefill_q: deque[int] = deque()
         self._next_id = 0
         self.blocks = 0
+        # the virtual block the last step_block() entered on, and the
+        # pipeline depth at that entry — load_summary stamps signal
+        # freshness from THESE, not self.blocks, because an idle sync step
+        # returns without advancing the clock (virtual time only moves
+        # when there is work) while its summary is fully current, and an
+        # async drain step that harvests the last in-flight block still
+        # only reflects device effects through the PREVIOUS block
+        self._observed_pin = 0
+        self._entry_inflight = 0
         # paged mode (lm built with page_size): admission additionally
         # consults the prefix index + page allocator — a prefix hit prefills
         # only the suffix, pool pressure defers admission instead of OOMing
@@ -780,7 +857,8 @@ class ServeEngine:
         greedy = bool(sampler.greedy or sampler.temperature == 0.0)
         return prompt, sampler, greedy
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+    def submit(self, prompt: Optional[np.ndarray] = None,
+               max_new_tokens: int = 0,
                sampler: Optional[Sampler] = None,
                eos_token_id: Optional[int] = None,
                arrival_block: int = 0,
@@ -789,7 +867,8 @@ class ServeEngine:
                tenant: str = "default",
                adapter: Optional[str] = None,
                grammar: Optional[str] = None,
-               request_id: Optional[int] = None) -> Union[int, "Rejected"]:
+               request_id: Optional[int] = None,
+               resume: Optional[int] = None) -> Union[int, "Rejected"]:
         """Queue a request; returns its id — or, when the bounded queue
         sheds it at arrival, a structured :class:`Rejected` with a
         retry-after estimate. The per-request ``sampler`` must agree with
@@ -807,7 +886,21 @@ class ServeEngine:
         ``request_id`` pins an external id (the Router's globally-unique
         ids) instead of the engine's own counter: the per-request rng
         contract keys streams on the id, so a request replayed on another
-        replica under the same id is bit-identical wherever it runs."""
+        replica under the same id is bit-identical wherever it runs.
+
+        ``resume`` is the conversation tier's re-entry point (the next user
+        turn of a parked session): ``submit(resume=rid)`` takes no prompt —
+        the durable park record carries the whole request — and delegates
+        to :meth:`resume_parked` (exact page re-adoption, or re-prefill on
+        any degradation — never a wrong token)."""
+        if resume is not None:
+            if prompt is not None:
+                raise ValueError(
+                    "submit(resume=rid) takes no prompt — the parked "
+                    "record carries the request")
+            return self.resume_parked(int(resume))
+        if prompt is None:
+            raise ValueError("prompt required (or pass resume=<parked id>)")
         prompt, sampler, greedy = self._validate_submit(
             prompt, max_new_tokens, sampler)
         self._validate_adapter(adapter)
@@ -1395,6 +1488,7 @@ class ServeEngine:
         ts = self._out_ts.pop(req.request_id, [])
         self._submit_ts.pop(req.request_id, None)
         self._last_tok_ts.pop(req.request_id, None)
+        self._decode_since.pop(req.request_id, None)
         self._release_adapter(req)   # retire unpins (adapter stays resident)
         self._release_grammar(req)   # ... and the grammar pin likewise
         if self.incident is not None and (expired or self._missed(req)):
@@ -2606,6 +2700,428 @@ class ServeEngine:
         self._replay_tokens += req.max_new_tokens
         return req.request_id
 
+    # --- conversation tier: park / resume --------------------------------
+    # The durable third rung of the capacity ladder (ROADMAP #21): an idle
+    # decoding stream's KV pages + request state spill to the park store
+    # (inference/conversation_tier.py) and the slot is evicted ENTIRELY —
+    # 0 device pages, 0 host-tier pages, 0 prefix-index entries. Resume
+    # re-adopts the pages without re-prefill (the adopt_handoff discipline:
+    # verify framing stamps, pin adapter/grammar BEFORE page work, install
+    # mirrors between blocks); any degradation — torn manifest, corrupt
+    # bytes, read fault, foreign tp_degree/page_dtype, state-only park —
+    # lands on the replay path, bit-identical to a cold stream.
+
+    def _parked_request(self, st: dict, delta: int) -> Request:
+        """Rebuild a :class:`Request` from a parked state dict, shifting
+        every block stamp by ``delta`` (blocks spent parked are off the
+        clock: a user's think-time must not burn stream deadlines or count
+        as decode/queue time in the completion)."""
+        def shift(v):
+            return None if v is None else int(v) + delta
+
+        req = Request(
+            request_id=int(st["request_id"]),
+            prompt=np.asarray(st["prompt"], np.int32),
+            max_new_tokens=int(st["max_new_tokens"]),
+            eos_token_id=st.get("eos_token_id"),
+            temperature=float(st.get("temperature", 0.0)),
+            greedy=bool(st.get("greedy", True)),
+            arrival_block=int(st.get("arrival_block", 0)) + delta,
+            submit_block=self.blocks,
+            ttft_deadline_block=shift(st.get("ttft_deadline_block")),
+            deadline_block=shift(st.get("deadline_block")),
+            tenant=st.get("tenant", "default"),
+            adapter=st.get("adapter"),
+            grammar=st.get("grammar"),
+        )
+        req.start_block = shift(st.get("start_block"))
+        req.first_token_block = shift(st.get("first_token_block"))
+        return req
+
+    def park(self, request_id: int) -> str:
+        """Park one decoding conversation to the durable tier and evict it
+        from device AND host. Returns ``"parked"`` (the injected write
+        faults — state-only or torn park — are deliberately invisible
+        here: they surface at resume, as degradations) or ``"retired"``
+        when the async drain finds the stream already finished.
+
+        Ordering is crash-consistent: pages are exported and the store
+        write completes BEFORE any engine state mutates — a storage
+        exception (after ``_retry`` exhaustion) propagates with the
+        conversation still live, nothing leaked, nothing lost. Only after
+        the durable write does the eviction commit; from there every exit
+        releases the slot, its pages, and its adapter/grammar pins."""
+        if self.park_store is None:
+            raise ValueError(
+                "parking requires park_dir/park_store at construction")
+        if self.role == "prefill":
+            raise ValueError(
+                "prefill workers hold no decode streams to park")
+        rid = int(request_id)
+        slot = next((i for i, r in enumerate(self.slots)
+                     if r is not None and r.request_id == rid), None)
+        if slot is None or slot in self._prefilling:
+            raise ValueError(f"request {rid} is not a decoding stream")
+        if self.async_loop:
+            # designated sync point: the in-flight block may still emit for
+            # (or finish) this slot — drain before freezing its state
+            self._flush()
+            self._retire_finished()
+            cur = self.slots[slot]
+            if cur is None or cur.request_id != rid:
+                return "retired"
+        if self._done[slot]:
+            # finished while we looked: nothing to park, the next
+            # scheduling pass retires it with a normal completion
+            return "retired"
+        from neuronx_distributed_tpu.inference.partition import tp_degree
+
+        req = self.slots[slot]
+        t0 = time.perf_counter()
+        generated = [int(t) for t in self._out[rid]]
+        length = int(self._lengths[slot])
+        # the stream-state invariant: the cache covers prompt +
+        # generated[:-1] (the last sampled token rides _tok, unfed), so
+        # length == prompt + len(generated) - 1 and the page export copies
+        # exactly ceil(length/page_size) pages
+        covered = [int(t) for t in req.prompt] + generated[:-1]
+        assert length == len(covered), (length, len(covered))
+        pkv = self.session.paged
+        n_copy = -(-length // pkv.page_size)
+        pages = [int(p) for p in pkv.tables[slot][:n_copy]]
+        payloads = self._read_pages_bytes(pages)
+        state = {
+            "request_id": rid,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": (None if req.eos_token_id is None
+                             else int(req.eos_token_id)),
+            "temperature": float(req.temperature),
+            "greedy": bool(req.greedy),
+            "arrival_block": int(req.arrival_block),
+            "ttft_deadline_block": req.ttft_deadline_block,
+            "deadline_block": req.deadline_block,
+            "tenant": req.tenant,
+            "adapter": req.adapter,
+            "grammar": req.grammar,
+            "grammar_state": (int(self._gstate[slot])
+                              if self.grammar and req.grammar is not None
+                              else None),
+            "generated": generated,
+            "length": length,
+            "parked_block": int(self.blocks),
+            "start_block": req.start_block,
+            "first_token_block": req.first_token_block,
+            # the request's rng base as portable key data: a resume on a
+            # replica sharing the fleet rng base derives the same key via
+            # _req_key, but the stamp makes the park self-contained
+            "rng_key": np.asarray(
+                jax.random.key_data(self._req_key(rid))).tolist(),
+        }
+        manifest_id, _verdict = self.park_store.park(
+            rid, state, payloads, tp_degree=tp_degree(),
+            page_dtype=self._page_dtype())
+        # durable write landed — commit the eviction: prefix-index entries
+        # first (purge captures the slot's page list before retire frees
+        # it), then device state, then every host mirror and pin
+        pkv.purge_conversation(slot, tokens=covered, ns=req.adapter)
+        self.lm.retire(self.session, np.asarray([slot], np.int32))
+        self.slots[slot] = None
+        self._active[slot] = False
+        self._done[slot] = False
+        self._adapter_idx[slot] = 0
+        self._release_adapter(req)
+        self._release_grammar(req)
+        self._gidx[slot] = 0
+        self._gstate[slot] = 0
+        self._staged.pop(slot, None)
+        self._out.pop(rid, None)
+        self._decode_since.pop(rid, None)
+        self._parked[rid] = {
+            "req": req,
+            "state": state,
+            "manifest_id": manifest_id,
+            "parked_block": int(self.blocks),
+            # wall stamps survive for in-process resume continuity (the
+            # completion's token_ts); a cross-process resume re-stamps
+            "out_ts": self._out_ts.pop(rid, []),
+            "last_tok_ts": self._last_tok_ts.pop(rid, None),
+            "submit_ts": self._submit_ts.pop(rid, None),
+        }
+        self.stats["parked"] += 1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._m_park.observe(dt_ms)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "park", ("req", rid), block=self.blocks,
+                args={"slot": int(slot), "pages": n_copy,
+                      "generated": len(generated),
+                      "manifest": manifest_id, "ms": round(dt_ms, 3)})
+            self.tracer.instant(
+                "tier:park", (self.lane, "tier"), block=self.blocks,
+                args={"rid": rid, "pages": n_copy,
+                      "manifest": manifest_id})
+        return "parked"
+
+    def _sweep_idle_parks(self) -> None:
+        """Idle detection on the virtual block clock (deterministic — the
+        trace's stand-in for user think-time): a decoding stream that has
+        run ``park_idle_blocks`` blocks since it (re)entered decode is
+        parked at the top of the scheduling round, a designated sync
+        point. Resume is explicit (``submit(resume=rid)``) — parked
+        conversations never block drain."""
+        if self.park_store is None or not self.park_idle_blocks:
+            return
+        for slot, req in enumerate(self.slots):
+            if (req is None or slot in self._prefilling
+                    or self._done[slot]):
+                continue
+            since = self._decode_since.setdefault(req.request_id,
+                                                  self.blocks)
+            if self.blocks - since >= self.park_idle_blocks:
+                self.park(req.request_id)
+
+    def _park_deferred(self, rid: int, reason: str) -> "Rejected":
+        """Structured can't-resume-RIGHT-NOW verdict: the parked record is
+        untouched (still durable, still resumable) — retry after the pool
+        estimate. Not a shed: nothing was lost."""
+        rej = Rejected(
+            request_id=rid,
+            retry_after_blocks=max(self._pool_retry_after(), 1),
+            queue_depth=self.queue.arrived_count(self.blocks),
+            reason=reason)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "park_defer", ("req", rid), block=self.blocks,
+                args={"reason": reason,
+                      "retry_after_blocks": rej.retry_after_blocks})
+        return rej
+
+    def _resume_degraded(self, rid: int, st: Optional[dict],
+                         reason: str, corrupt: bool) -> Union[int, "Rejected"]:
+        """The degradation ladder's landing: re-prefill via the replay
+        path, bit-identical to a cold stream per the rng contract. ``st``
+        is the best surviving state (durable park state, recovered state
+        shard, or the in-process record); None at every rung means the
+        conversation is unresumable — a structured reject, never a guess."""
+        rec = self._parked.get(rid)
+        if st is None and rec is not None:
+            st = rec["state"]
+        if st is None:
+            rej = Rejected(
+                request_id=rid, retry_after_blocks=0,
+                queue_depth=self.queue.arrived_count(self.blocks),
+                reason="park_unresumable")
+            self.rejected.append(rej)
+            self.stats["rejected"] += 1
+            self.stats["park_rejects"] += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "reject", ("req", rid), block=self.blocks,
+                    args={"reason": "park_unresumable", "cause": reason})
+            return rej
+        delta = self.blocks - int(st.get("parked_block", self.blocks))
+        req = self._parked_request(st, delta)
+        generated = [int(t) for t in st.get("generated", [])]
+        self.stats["park_replays"] += 1
+        if self.tracer.enabled:
+            if corrupt:
+                self.tracer.instant(
+                    "tier:park_corrupt", (self.lane, "tier"),
+                    block=self.blocks, args={"rid": rid, "cause": reason})
+            self.tracer.instant(
+                "tier:park_degraded", (self.lane, "tier"),
+                block=self.blocks, args={"rid": rid, "cause": reason})
+        # corrupt/torn stores were already quarantined (forensic record
+        # kept); a clean-but-unusable park (state-only, foreign framing)
+        # is consumed — drop the durable copy so ids can be reused
+        if not corrupt:
+            self.park_store.remove(rid)
+        self._parked.pop(rid, None)
+        return self.resume(req, generated)
+
+    def resume_parked(self, request_id: int) -> Union[int, "Rejected"]:
+        """Resume a parked conversation without re-prefill: load + verify
+        the durable record, re-adopt its KV pages into a free slot, and
+        re-enter decode at the exact interruption point — the next sampled
+        token is bit-identical to an uninterrupted run (the stream-state
+        invariant restores ``_tok``/``_gen_counts``/``_lengths`` exactly,
+        and the rng key comes from the parked stamp).
+
+        Verdicts: the request id (stream live again); ``Rejected`` with
+        ``reason="park_deferred"`` (no free slot / pool or pin pressure —
+        the park record is untouched, retry later); ``Rejected`` with
+        ``reason="park_unresumable"`` (no durable record and no in-process
+        record). Every integrity failure degrades to the replay path
+        (:meth:`_resume_degraded`) — never a wrong token."""
+        from neuronx_distributed_tpu.inference.conversation_tier import (
+            ParkError, ParkIntegrityError)
+        from neuronx_distributed_tpu.inference.partition import tp_degree
+
+        if self.park_store is None:
+            raise ValueError(
+                "resume requires park_dir/park_store at construction")
+        if self.role == "prefill":
+            raise ValueError(
+                "a prefill worker cannot resume decode streams — route "
+                "resumes to a decode-capable worker")
+        rid = int(request_id)
+        if self.async_loop:
+            # designated sync point: page adoption + mirror install must
+            # land on a true block boundary
+            self._flush()
+            self._retire_finished()
+        t0 = time.perf_counter()
+        try:
+            parked = self.park_store.load(rid)
+        except ParkIntegrityError as e:
+            # torn or corrupt: the store quarantined it; the state shard
+            # may still verify independently — the middle rung
+            return self._resume_degraded(
+                rid, self.park_store.recover_state(rid),
+                reason=str(e), corrupt=True)
+        except ParkError as e:
+            # read fault (transient storage, or injected): degrading to
+            # re-prefill is always safe and keeps the outcome deterministic
+            return self._resume_degraded(
+                rid, self.park_store.recover_state(rid),
+                reason=str(e), corrupt=False)
+        st = parked.state
+        if parked.payloads is None:
+            return self._resume_degraded(rid, st, reason="state_only_park",
+                                         corrupt=False)
+        if parked.tp_degree != tp_degree():
+            return self._resume_degraded(
+                rid, st, reason=f"tp_mismatch:{parked.tp_degree}",
+                corrupt=False)
+        if parked.page_dtype != self._page_dtype():
+            return self._resume_degraded(
+                rid, st, reason=f"page_dtype_mismatch:{parked.page_dtype}",
+                corrupt=False)
+        generated = [int(t) for t in st["generated"]]
+        length = int(st["length"])
+        prompt = np.asarray(st["prompt"], np.int32)
+        covered = [int(t) for t in prompt] + generated[:-1]
+        pkv = self.session.paged
+        if (length != len(covered) or not generated
+                or len(parked.payloads) != -(-length // pkv.page_size)):
+            # the manifest verified but the state is inconsistent with the
+            # page framing — structurally unusable, re-prefill
+            return self._resume_degraded(rid, st, reason="state_mismatch",
+                                         corrupt=False)
+        delta = self.blocks - int(st["parked_block"])
+        rec = self._parked.get(rid)
+        req = self._parked_request(st, delta)
+        free = self._free_slots()
+        if not free:
+            return self._park_deferred(rid, "park_deferred")
+        if not self._pool_can_admit(prompt.size, req.max_new_tokens):
+            self._note_pool_pressure([req])
+            return self._park_deferred(rid, "park_deferred")
+        # pins BEFORE page work (the adopt_handoff discipline); a deferral
+        # at any rung releases everything taken so far — the parked record
+        # stays whole and nothing leaks
+        if self.lora and req.adapter is not None \
+                and rid not in self._adapter_pins:
+            try:
+                self.session.adapters.acquire(req.adapter)
+                self._adapter_pins[rid] = req.adapter
+            except (AdapterPoolExhausted, AdapterLoadError):
+                return self._park_deferred(rid, "park_deferred")
+        gslot = 0
+        if self.grammar and req.grammar is not None:
+            if rid not in self._grammar_pins:
+                try:
+                    self.session.grammars.acquire(req.grammar)
+                    self._grammar_pins[rid] = req.grammar
+                except (GrammarPoolExhausted, GrammarLoadError):
+                    self._release_adapter(req)
+                    return self._park_deferred(rid, "park_deferred")
+            gslot = self.session.grammars.slot_of(req.grammar)
+        slot = free[0]
+        try:
+            pkv.adopt_pages(
+                slot, covered, parked.payloads, self._write_pages_bytes,
+                prompt.size + req.max_new_tokens + self._reserve_slack(),
+                ns=req.adapter)
+        except PagePoolExhausted:
+            self._release_adapter(req)
+            self._release_grammar(req)
+            self._note_pool_pressure([req])
+            return self._park_deferred(rid, "park_deferred")
+        self.session.cache = _set_block_tables(self.session.cache,
+                                               pkv.tables)
+        self.session.cache = _set_cache_index_rows(
+            self.session.cache, [slot], [length])
+        self._next_id = max(self._next_id, rid + 1)
+        self.slots[slot] = req
+        now = time.perf_counter()
+        self._out[rid] = list(generated)
+        if rec is not None and rec.get("out_ts"):
+            self._out_ts[rid] = list(rec["out_ts"])
+            self._last_tok_ts[rid] = (rec.get("last_tok_ts")
+                                      or rec["out_ts"][-1])
+        else:
+            self._out_ts[rid] = [now] * len(generated)
+            self._last_tok_ts[rid] = now
+        if rec is not None and rec.get("submit_ts") is not None:
+            self._submit_ts[rid] = rec["submit_ts"]
+        self._lengths[slot] = length
+        self.session.lengths[slot] = length
+        self.session.active[slot] = True
+        self._active[slot] = True
+        self._done[slot] = False
+        self._eos[slot] = (-1 if req.eos_token_id is None
+                           else req.eos_token_id)
+        self._temp[slot] = req.temperature
+        self._greedy[slot] = req.greedy
+        # the stream-state invariant, restored exactly: generated[-1] is
+        # the last sampled token, held unfed — the next block feeds it;
+        # gen_counts makes the device's next draw fold_in(key, len(gen)),
+        # precisely the draw an uninterrupted run would take next
+        self._tok[slot] = int(generated[-1])
+        self._slot_keys = self._slot_keys.at[slot].set(
+            jax.random.wrap_key_data(
+                jnp.asarray(st["rng_key"], jnp.uint32)))
+        self._gen_counts[slot] = len(generated)
+        self._adapter_idx[slot] = self._adapter_slot(req)
+        self._gidx[slot] = gslot
+        # recomputed from the delivered tokens — can never drift from the
+        # parked stamp (which load() verified, but the walk is authoritative)
+        self._gstate[slot] = (self._grammar_walk(req.grammar, 0, generated)
+                              if gslot else 0)
+        self._gbudget[slot] = req.max_new_tokens
+        if self.async_loop:
+            self._staged[slot] = None
+        self.stats["resumed"] += 1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._m_park_resume.observe(dt_ms)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "resume", ("req", rid), block=self.blocks, ts=now,
+                args={"slot": int(slot), "pages": len(parked.payloads),
+                      "generated": len(generated),
+                      "parked_blocks": delta, "ms": round(dt_ms, 3)})
+            self.tracer.instant(
+                "tier:resume", (self.lane, "tier"), block=self.blocks,
+                args={"rid": rid, "pages": len(parked.payloads),
+                      "parked_blocks": delta})
+        # the durable record is consumed — a second resume of the same id
+        # must come from a NEW park, not replay a stale one
+        self.park_store.remove(rid)
+        self._parked.pop(rid, None)
+        self._decode_since[rid] = self.blocks
+        return rid
+
+    def parked_ids(self) -> List[int]:
+        """Ids resumable from the durable store right now (the restart
+        recovery surface) merged with this process's in-memory park
+        records — ``submit(resume=rid)`` accepts any of them."""
+        ids = set(self._parked)
+        if self.park_store is not None:
+            ids.update(self.park_store.list_parked())
+        return sorted(ids)
+
     def extract_queued(self) -> List[Request]:
         """Remove and return every queued (not yet admitted) request — the
         drain path's migration source. No completions are recorded; the
@@ -2742,12 +3258,27 @@ class ServeEngine:
                 "host_tier_pages": self.host_tier_pages,
                 "paged": self.paged,
                 "async_loop": self.async_loop,
+                "park_idle_blocks": self.park_idle_blocks,
+                "park_dir": (self.park_store.dirname
+                             if self.park_store is not None else None),
             },
             # tier CONTENT is deliberately dropped (host buffers die with
             # the process, exactly like device pages); the knob above makes
             # the restored engine re-enable an empty tier, and the replay
             # path re-prefills — bit-identical either way (test-pinned)
             "requests": reqs,
+            # parked conversations ride by MANIFEST ID, not content — the
+            # durable copy lives in the park store; the request/generated
+            # record here is the degradation ladder's last rung (a torn
+            # park resumes via replay from exactly this)
+            "parked": [dict(enc(rec["req"], "parked",
+                                rec["state"]["generated"]),
+                            manifest_id=rec["manifest_id"],
+                            parked_block=rec["parked_block"],
+                            start_block=rec["state"].get("start_block"),
+                            first_token_block=rec["state"].get(
+                                "first_token_block"))
+                       for _rid, rec in sorted(self._parked.items())],
         }
 
     def save_snapshot(self, path: str) -> None:
@@ -2784,6 +3315,14 @@ class ServeEngine:
             # restoring a tiered snapshot into a contiguous oracle: the
             # tier knob has no meaning there (streams are identical anyway)
             cfg.pop("host_tier_pages", None)
+            # ... and neither does parking (pages are the park unit);
+            # parked entries below degrade to replays — cold-identical
+            cfg.pop("park_idle_blocks", None)
+            cfg.pop("park_dir", None)
+        if cfg.get("park_dir") is None:
+            cfg.pop("park_dir", None)
+            if "park_store" not in overrides:
+                cfg.pop("park_idle_blocks", None)
         cfg.update(overrides)
         if not cfg.get("fused", True):
             # restoring into the stepwise oracle: the pipeline knob only
@@ -2832,10 +3371,52 @@ class ServeEngine:
                 # before queued entries, so they keep admission priority)
                 eng.queue.append(req)
             eng.stats["restored_requests"] += 1
+        for rd in snap.get("parked", []):
+            req = Request(
+                request_id=int(rd["request_id"]),
+                prompt=np.asarray(rd["prompt"], np.int32),
+                max_new_tokens=int(rd["max_new_tokens"]),
+                eos_token_id=rd["eos_token_id"],
+                temperature=float(rd["temperature"]),
+                greedy=bool(rd["greedy"]),
+                arrival_block=int(rd["arrival_block"]),
+                submit_block=eng.blocks,
+                ttft_deadline_block=rd.get("ttft_deadline_block"),
+                deadline_block=rd.get("deadline_block"),
+                tenant=rd.get("tenant", "default"),
+                adapter=rd.get("adapter"),
+                grammar=rd.get("grammar"),
+            )
+            generated = [int(t) for t in rd["generated"]]
+            state = {k: rd.get(k) for k in (
+                "request_id", "prompt", "max_new_tokens", "eos_token_id",
+                "temperature", "greedy", "arrival_block",
+                "ttft_deadline_block", "deadline_block", "tenant",
+                "adapter", "grammar", "grammar_state", "generated",
+                "start_block", "first_token_block")}
+            state["parked_block"] = int(rd.get("parked_block", eng.blocks))
+            state["length"] = len(rd["prompt"]) + len(generated) - 1
+            if eng.park_store is None:
+                # the restored engine has no durable store: the parked
+                # record can only re-prefill — schedule it now, which the
+                # rng contract keeps cold-identical
+                eng._replay_q.append((req, generated, []))
+                eng._replay_tokens += req.max_new_tokens
+            else:
+                # referenced by manifest id: resume_parked loads + verifies
+                # the durable copy; a torn/corrupt one replays from this
+                # record (the snapshot IS the last rung of the ladder)
+                eng._parked[req.request_id] = {
+                    "req": req, "state": state,
+                    "manifest_id": rd.get("manifest_id"),
+                    "parked_block": state["parked_block"],
+                    "out_ts": [], "last_tok_ts": None, "submit_ts": None}
+            eng.stats["restored_requests"] += 1
         if eng.tracer.enabled:
             eng.tracer.instant(
                 "restore", (eng.lane, "snapshot"), block=eng.blocks,
-                args={"requests": len(snap["requests"])})
+                args={"requests": len(snap["requests"])
+                      + len(snap.get("parked", []))})
         eng._drain_replays()
         return eng
 
@@ -2983,6 +3564,8 @@ class ServeEngine:
         dispatches, and only THEN is block t-1 fetched+harvested — the
         device never waits on the host between blocks (the pipelined
         variant; same decisions, same streams — see _step_block_async)."""
+        self._observed_pin = int(self.blocks)
+        self._entry_inflight = len(self._inflight)
         if self.async_loop:
             return self._step_block_async()
         return self._step_block_sync()
@@ -2992,6 +3575,7 @@ class ServeEngine:
         pipeline is tested bit-identical against."""
         self._emitted.clear()     # harvest reads last block's emissions
         self.queue.advance(self.blocks)
+        self._sweep_idle_parks()  # idle streams spill to the durable tier
         self._drain_replays()     # recovery work re-enters ahead of admits
         self._admit()
         self._retire_finished()   # a 1-token budget finishes at insert time
@@ -3167,6 +3751,7 @@ class ServeEngine:
         ``async-contract`` rule forbids blocking primitives on this path."""
         self._emitted.clear()
         self.queue.advance(self.blocks)
+        self._sweep_idle_parks()  # sync point: park() drains the pipeline
         self._drain_replays()
         self._admit()
         self._retire_finished()
@@ -3507,6 +4092,17 @@ class ServeEngine:
             slo_alerting=(self._slo is not None and self._slo.alerting()),
             decode_blocks=int(self.stats["decode_blocks"]),
             inserted_requests=int(self.stats["inserted_requests"]),
+            # newest virtual block whose device effects this summary
+            # reflects: the block the last step entered on, minus pipeline
+            # depth (async_loop lags by one; an idle or sync engine is
+            # fully current).  max() with the AT-ENTRY depth: a drain step
+            # that harvested the final in-flight block leaves the pipeline
+            # empty but its summary still only reflects through pin - 1
+            # (PR 19 remainder)
+            observed_block=(self._observed_pin
+                            - max(len(self._inflight),
+                                  self._entry_inflight)),
+            parked=len(self._parked),
         )
 
     def state_summary(self) -> dict:
@@ -3536,6 +4132,7 @@ class ServeEngine:
             "prefilling": load.prefilling,
             "replay_pending": load.replays,
             "slots": slots,
+            "parked": sorted(self._parked),
             "completed": len(self.completed),
             "rejected": len(self.rejected),
             # the shared typed card (ReplicaLoad) — same struct placement
@@ -3920,6 +4517,33 @@ def run_trace(engine: ServeEngine, trace: List[dict],
     t0 = time.perf_counter()
     completions = engine.run(max_blocks=max_blocks,
                              snapshot_path=snapshot_path)
+    # conversation tier (--park-idle-blocks): the drain above leaves
+    # auto-parked conversations durable but incomplete (parked streams
+    # never block drain). Resume each — the finite trace's stand-in for
+    # the user's return — and drain again until the trace is fully
+    # served. "park_deferred" is a retry-later verdict (the next drain
+    # frees the slot/pool it was waiting on); any other Rejected is
+    # terminal and already accounted in engine.rejected.
+    if getattr(engine, "park_idle_blocks", 0):
+        dead = set()
+        while True:
+            pending = [r for r in engine.parked_ids() if r not in dead]
+            if not pending:
+                break
+            resumed = 0
+            for rid in pending:
+                out = engine.submit(resume=rid)
+                if isinstance(out, Rejected):
+                    if out.reason != "park_deferred":
+                        dead.add(rid)
+                else:
+                    resumed += 1
+            if not resumed and not engine.step_block():
+                break  # nothing resumable and the clock is drained
+            # run() returns the engine's CUMULATIVE finish-order list, so
+            # re-binding (not +=) keeps each request counted once
+            completions = engine.run(max_blocks=max_blocks,
+                                     snapshot_path=snapshot_path)
     wall_s = time.perf_counter() - t0
     total_tokens = int(sum(len(c.tokens) for c in completions))
     decode_blocks = max(engine.stats["decode_blocks"], 1)
@@ -4029,6 +4653,21 @@ def run_trace(engine: ServeEngine, trace: List[dict],
         "trace_events": len(engine.tracer.events()),
         "trace_events_dropped": engine.tracer.dropped,
     })
+    if engine.park_store is not None:
+        # conversation-tier surface: parked_remaining > 0 means the trace
+        # ended with conversations still durable on disk (their bytes are
+        # the tier's footprint — device and host hold ZERO for them)
+        report.update({
+            "park_idle_blocks": engine.park_idle_blocks,
+            "parked": engine.stats["parked"],
+            "resumed": engine.stats["resumed"],
+            "park_replays": engine.stats["park_replays"],
+            "park_rejects": engine.stats["park_rejects"],
+            "parked_remaining": len(engine.parked_ids()),
+            "parked_bytes": int(sum(
+                engine.park_store.parked_bytes(r)
+                for r in engine.park_store.list_parked())),
+        })
     # per-tenant isolation surface (present whenever the trace labels
     # tenants): the aggregate numbers above hide exactly the thing a quota
     # system exists to protect — whose p99 a burst moved

@@ -62,6 +62,21 @@ in the same order, so "replay the storm" is a one-line reproducer:
   out-of-grammar token). Either way the stream is only ever decoded under
   its OWN, intact mask tables: a grammar fault is a latency event, never
   an unparseable completion — which the structured chaos tests assert.
+* **park** (``FaultInjector.on_park_write`` / ``on_park_read``) — per
+  conversation park/resume against the persistent conversation tier
+  (``inference/conversation_tier.py``). At the WRITE seam one draw decides:
+  ``'fail'`` (the KV shard write raises an IO error after retries — the
+  conversation parks STATE-ONLY and the next resume re-prefills) or
+  ``'torn'`` (the shards land but the process "dies" before the done
+  marker — a torn manifest, invisible to readers, quarantined on the next
+  load). At the READ seam one draw decides: ``'fail'`` (the manifest/shard
+  read raises — resume degrades to re-prefill from the parked state) or
+  ``'corrupt'`` (the stored bytes are garbled at rest; the per-shard
+  sha256 or per-page crc32 catches it, the manifest is quarantined, and
+  the path degrades to the same re-prefill). Every verdict lands on the
+  re-prefill path, which the per-request rng contract keeps bit-identical
+  to a cold stream: a park fault is a latency event, never a wrong token —
+  which the conversation-tier chaos tests assert.
 * **tier** (``FaultInjector.on_tier_restore``) — per host-tier page read,
   the restore may FAIL outright (``tier_restore_fail_prob`` — an IO error:
   the entry is dropped, the admission re-prefills the suffix) or the tier
@@ -118,6 +133,9 @@ class FaultPlan:
     grammar_corrupt_prob: float = 0.0
     migrate_fail_prob: float = 0.0
     migrate_corrupt_prob: float = 0.0
+    park_write_fail_prob: float = 0.0
+    park_read_fail_prob: float = 0.0
+    park_corrupt_prob: float = 0.0
 
     def __post_init__(self):
         for name in ("pool_exhaust_prob", "dispatch_fail_prob",
@@ -125,7 +143,9 @@ class FaultPlan:
                      "tier_restore_fail_prob", "tier_corrupt_prob",
                      "adapter_load_fail_prob", "adapter_corrupt_prob",
                      "grammar_load_fail_prob", "grammar_corrupt_prob",
-                     "migrate_fail_prob", "migrate_corrupt_prob"):
+                     "migrate_fail_prob", "migrate_corrupt_prob",
+                     "park_write_fail_prob", "park_read_fail_prob",
+                     "park_corrupt_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -145,6 +165,10 @@ class FaultPlan:
             raise ValueError(
                 "migrate_fail_prob + migrate_corrupt_prob must be <= 1 "
                 "(one verdict per handoff)")
+        if self.park_read_fail_prob + self.park_corrupt_prob > 1.0:
+            raise ValueError(
+                "park_read_fail_prob + park_corrupt_prob must be <= 1 "
+                "(one verdict per resume read)")
         if self.pool_storm_len < 1 or self.dispatch_max_failures < 1:
             raise ValueError("storm lengths must be >= 1")
         if self.max_replica_crashes < 0:
@@ -180,7 +204,7 @@ class FaultInjector:
             seam: np.random.RandomState(
                 (plan.seed * 0x9E3779B1 + zlib.crc32(seam.encode())) % (2**32))
             for seam in ("alloc", "dispatch", "corrupt", "replica", "tier",
-                         "adapter", "grammar", "migrate")
+                         "adapter", "grammar", "migrate", "park")
         }
         self._storm_left = 0
         self._fail_left: Dict[str, int] = {}
@@ -190,7 +214,9 @@ class FaultInjector:
                       "tier_restore_faults": 0, "tier_corruptions": 0,
                       "adapter_load_faults": 0, "adapter_corruptions": 0,
                       "grammar_load_faults": 0, "grammar_corruptions": 0,
-                      "migrate_faults": 0, "migrate_corruptions": 0}
+                      "migrate_faults": 0, "migrate_corruptions": 0,
+                      "park_write_faults": 0, "park_torn_manifests": 0,
+                      "park_read_faults": 0, "park_corruptions": 0}
 
     # --- allocator seam --------------------------------------------------
 
@@ -293,6 +319,52 @@ class FaultInjector:
             return "fail"
         if u < mfp + mcp:
             self.stats["migrate_corruptions"] += 1
+            return "corrupt"
+        return None
+
+    # --- park seam -------------------------------------------------------
+
+    def on_park_write(self) -> Optional[str]:
+        """Called by the conversation park store per park WRITE: one draw
+        decides the verdict — ``'fail'`` (the KV shard write raises after
+        retries: the park degrades to a state-only manifest, so the next
+        resume re-prefills), ``'torn'`` (shards and manifest land but the
+        done marker never does — the crash-mid-park shape; readers never
+        see the partial park, the quarantine path reclaims it), or None
+        (clean park). Both failure shapes share ``park_write_fail_prob``
+        (one draw split down the middle) so the seam stays one-draw-per-op
+        and plans replay identically."""
+        p = self.plan.park_write_fail_prob
+        if not p:
+            return None
+        u = self._rs["park"].random_sample()
+        if u < p * 0.5:
+            self.stats["park_write_faults"] += 1
+            return "fail"
+        if u < p:
+            self.stats["park_torn_manifests"] += 1
+            return "torn"
+        return None
+
+    def on_park_read(self) -> Optional[str]:
+        """Called by the conversation park store per resume READ: one draw
+        decides the verdict — ``'fail'`` (the manifest/shard read raises:
+        resume degrades to re-prefill from the parked request state),
+        ``'corrupt'`` (the stored bytes are garbled at rest; the per-shard
+        sha256 / per-page crc32 catches it, the manifest is quarantined,
+        and the path degrades to the same re-prefill), or None (clean
+        read). One draw per read keeps the seam's schedule independent of
+        which verdict fired — the tier/migrate seams' discipline."""
+        frp = self.plan.park_read_fail_prob
+        pcp = self.plan.park_corrupt_prob
+        if not (frp or pcp):
+            return None
+        u = self._rs["park"].random_sample()
+        if u < frp:
+            self.stats["park_read_faults"] += 1
+            return "fail"
+        if u < frp + pcp:
+            self.stats["park_corruptions"] += 1
             return "corrupt"
         return None
 

@@ -620,6 +620,33 @@ class RadixPrefixIndex:
         scrub(self.root)
         return removed
 
+    def invalidate_tokens(self, tokens: Sequence[int]) -> int:
+        """Drop the trie path covering ``tokens`` — subtree included, device
+        holds released, tier copies dropped. The park path's residency
+        scrub: a conversation evicted to the durable tier must leave no
+        device OR host copy behind, and unlike :meth:`invalidate_pages`
+        this also reaches entries that are tiered-ONLY (page = -1, so no
+        physical-page report could ever name them). Aggressive by design:
+        siblings sharing the first page lose their cache entries too (their
+        slot holds are untouched — only the cache's copies go), the same
+        first-page-subtree blast radius ``invalidate_pages`` already has.
+        Returns entries removed."""
+        ps = self.page_size
+        if len(tokens) < ps:
+            return 0        # no full page was ever registered
+        # key exactly as register() does: raw stream elements — an
+        # adapter-namespaced stream carries (ns, token) tuples, which an
+        # int() coercion would reject; plain streams normalize to int
+        key = tuple(t if isinstance(t, tuple) else int(t)
+                    for t in tokens[:ps])
+        child = self.root.children.get(key)
+        if child is None:
+            return 0
+        before = self.cached_pages
+        self._drop_subtree(child)
+        del self.root.children[key]
+        return before - self.cached_pages
+
     def _drop_subtree(self, node) -> int:
         """Remove ``node`` and its descendants from all accounting: device
         holds released, tier copies dropped, DEAD-marked (page = -1, no
@@ -1014,6 +1041,28 @@ class PagedKVCache:
         if pages:
             self.allocator.release(pages)
         self.tables[slot] = self.scratch[slot]
+
+    def purge_conversation(self, slot: int,
+                           tokens: Optional[Sequence[int]] = None,
+                           ns: Optional[str] = None) -> int:
+        """Park-path residency scrub (page export/import BELOW the host
+        tier): release the slot's holds AND remove every prefix-index entry
+        reachable through its pages or its token path — device copies freed,
+        host-tier copies dropped. After this, an idle parked conversation
+        holds 0 device and 0 host pages (the acceptance invariant); its only
+        copy is the durable one the caller just wrote. The token-path pass
+        catches tiered-ONLY entries (page = -1) that a physical-page report
+        cannot name. Returns prefix entries removed."""
+        pages = [int(p) for p in self._slot_pages.get(slot, ())]
+        self.release(slot)
+        removed = 0
+        if self.prefix is not None:
+            if pages:
+                removed += self.prefix.invalidate_pages(pages)
+            if tokens is not None:
+                removed += self.prefix.invalidate_tokens(
+                    _ns_tokens(tokens, ns))
+        return removed
 
     def adopt_pages(self, slot: int, tokens: Sequence[int],
                     payloads: Sequence[Dict[str, np.ndarray]], write_pages,

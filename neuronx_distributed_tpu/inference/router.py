@@ -246,6 +246,16 @@ class Router:
         # lm + engine_kw are retained: the autoscaler spawns replicas with
         # the SAME recipe mid-run (homogeneous fleet by construction)
         self.lm = lm
+        # fleet-global park store (ROADMAP #21): ONE ConversationParkStore
+        # shared by every replica — including autoscaler-spawned ones — so
+        # a conversation parked by a replica that later drains, scales
+        # down, or crashes resumes on any survivor by request id alone
+        if engine_kw.get("park_dir") is not None:
+            from neuronx_distributed_tpu.inference.conversation_tier import (
+                ConversationParkStore)
+            engine_kw = dict(engine_kw)
+            engine_kw["park_store"] = ConversationParkStore(
+                engine_kw.pop("park_dir"))
         self._engine_kw = dict(engine_kw)
         self.engines: List[ServeEngine] = self._build_engines(
             lm, num_replicas, engine_kw)
@@ -669,6 +679,69 @@ class Router:
         self._m_pending.set(self.pending.ready_count(self.blocks))
         return rid
 
+    # --- conversation tier (ROADMAP #21) ----------------------------------
+
+    def _park_store(self):
+        return self._engine_kw.get("park_store")
+
+    def parked_ids(self) -> List[int]:
+        """Ids resumable from the fleet-global park store (plus any
+        replica's in-process park records) — ``resume_parked`` accepts any
+        of them, on any live decode-capable replica."""
+        ids: set = set()
+        for i in self._live_replicas():
+            if self.engines[i].park_store is not None:
+                ids.update(self.engines[i].parked_ids())
+        return sorted(ids)
+
+    def resume_parked(self, request_id: int) -> Union[int, Rejected]:
+        """Resume a parked conversation on a live decode-capable replica.
+        The store is fleet-global, so the parking replica does NOT need to
+        survive: a drained, scaled-down, or crashed replica's parked
+        conversations resume anywhere. Prefers the replica still holding
+        the in-process park record (wall-stamp continuity), else the
+        least-loaded one. The engine's structured verdicts pass through
+        (``park_deferred`` — retry later, record untouched;
+        ``park_unresumable`` — nothing durable survived)."""
+        rid = int(request_id)
+        cands = [i for i in self._live_replicas()
+                 if self.role_of(i) != "prefill"
+                 and self.engines[i].park_store is not None]
+        if not cands:
+            raise NoLiveReplicas(
+                "no live decode-capable replica with a park store")
+        holder = next((i for i in cands
+                       if rid in self.engines[i]._parked), None)
+        i = holder if holder is not None else min(cands, key=self._score0)
+        verdict = self.engines[i].resume_parked(rid)
+        if isinstance(verdict, Rejected):
+            return verdict
+        self._next_id = max(self._next_id, rid + 1)
+        rec = self._records.get(rid)
+        if rec is None:
+            # parked before this router existed (restart) or record was
+            # dropped: rebuild from the resumed stream so failover and
+            # delivery tracking cover it like any placed request
+            req = next((r for r in self.engines[i].slots
+                        if r is not None and r.request_id == rid), None)
+            if req is not None:
+                rec = _Record(req=req, tenant=req.tenant, finish_tag=0.0,
+                              v_start=0.0)
+                self._records[rid] = rec
+                if self.keep_completions:
+                    self._tenant_of[rid] = req.tenant
+        if rec is not None:
+            rec.replica = i
+            toks = self.engines[i]._out.get(rid)
+            if toks is not None and len(toks) > len(rec.delivered):
+                rec.delivered = list(toks)
+        self._refresh_load(i)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "route_resume", ("router", "place"), block=self.blocks,
+                args={"rid": rid, "replica": i})
+        return rid
+
     def _free_capacity(self) -> int:
         # running sum over the live fleet's cached load summaries — O(1)
         # per submit instead of an every-replica slot scan
@@ -1047,6 +1120,7 @@ class Router:
             snap_gen = {int(r["request_id"]): [int(t) for t in r["generated"]]
                         for r in snap.get("requests", ())}
         moved = 0
+        store = self._park_store()
         for rid in sorted(self._records, reverse=True):
             rec = self._records[rid]
             if rec.replica != i:
@@ -1057,6 +1131,12 @@ class Router:
             rec.delivered = list(gen)
             self.pending.appendleft(self._make_replay_entry(rec, gen))
             moved += 1
+            # the replica may have parked this stream the very block it
+            # died (before harvest un-pinned the record): the failover
+            # replay is now the one true stream — drop the stale durable
+            # park so a later resume can never fork it
+            if store is not None and store.contains(rid):
+                store.remove(rid)
         self.stats["failovers"] += 1
         self.stats["failed_over_requests"] += moved
         self.last_failover_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -1210,6 +1290,18 @@ class Router:
                 toks = eng._out.get(rid)
                 if toks is not None and len(toks) > len(rec.delivered):
                     rec.delivered.extend(toks[len(rec.delivered):])
+        # park mirroring: a stream the replica parked this block now lives
+        # in the fleet-global store, not on the replica — un-pin the record
+        # so a later crash of replica i does NOT failover-replay it (that
+        # would fork the stream against its own durable park); delivery
+        # records sync to the parked token list for the replay-ladder rung
+        for rid, prec in eng._parked.items():
+            rec = self._records.get(rid)
+            if rec is not None and rec.replica == i:
+                rec.replica = None
+                gen = prec["state"].get("generated", [])
+                if len(gen) > len(rec.delivered):
+                    rec.delivered = [int(t) for t in gen]
 
     def _pump_handoffs(self) -> None:
         """Prefill→decode handoff choreography — a no-op here; the

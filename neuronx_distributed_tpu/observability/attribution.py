@@ -38,7 +38,12 @@ non-overlapping phase segments:
   the ``replay_admit`` that resumed the stream);
 * ``failover_replay`` — a replica crash: the blocks between the last
   delivered token and the survivor's ``replay_admit`` (lost block +
-  heartbeat detection + replay — exactly the failover price).
+  heartbeat detection + replay — exactly the failover price);
+* ``park_resume``     — the persistent conversation tier: the span between
+  an idle stream spilling to durable storage (``park``) and the exact
+  page re-adoption that resumed it (``resume``) — or, when the durable
+  record was unusable, the ``replay_admit`` after the degraded re-prefill
+  (the whole park→re-enter gap is the park price, never a crash's).
 
 HARD INVARIANT: the phase widths sum to the measured end-to-end latency —
 ``sum(phases_blocks.values()) == end_block - origin_block``, exactly, for
@@ -61,7 +66,7 @@ import numpy as np
 
 PHASES = ("queued", "requeue_backoff", "pool_wait", "adapter_load",
           "prefill", "decode", "migration", "corrupt_replay",
-          "failover_replay")
+          "failover_replay", "park_resume")
 
 # terminal lifecycle events: the walker closes the open phase here
 _TERMINALS = ("retire", "expire", "cancel", "shed", "reject")
@@ -116,7 +121,8 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
     annotations = {"prefill_chunks": 0, "requeues": 0, "pool_defers": 0,
                    "tier_restored_pages": 0, "replays": 0,
                    "adapter_defers": 0, "adapter_loads": 0,
-                   "handoff_pages": 0, "migrate_degrades": 0}
+                   "handoff_pages": 0, "migrate_degrades": 0,
+                   "parks": 0}
     first_token_block = None
 
     def close(upto_block, upto_ts, name=None):
@@ -207,6 +213,15 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
             close(blk, ts)
             phase = "corrupt_replay"
             annotations["replays"] += 1
+        elif name == "park":
+            # the stream left the machines for the durable tier: everything
+            # until the resume (exact or degraded) is the park price
+            close(blk, ts)
+            phase = "park_resume"
+            annotations["parks"] += 1
+        elif name == "resume":
+            close(blk, ts, "park_resume")
+            phase = "decode"
         elif name == "replay_admit":
             if phase == "migration":
                 # a degraded handoff's local re-prefill resumed the stream:
@@ -215,6 +230,12 @@ def request_attribution(tracer, request_id: int) -> Optional[dict]:
                 annotations["replays"] += 1
             elif phase == "corrupt_replay":
                 close(blk, ts, "corrupt_replay")
+            elif phase == "park_resume":
+                # a degraded park resume re-enters through the replay
+                # machinery: the whole park→re-prefill gap stays charged
+                # to the park, not to a crash
+                close(blk, ts, "park_resume")
+                annotations["replays"] += 1
             else:
                 # crash gap: decode ran until the last delivered token,
                 # everything after is the failover price

@@ -68,6 +68,16 @@ also records the sync bases the >= 2x gap pin divides against):
 
     JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
         --async-update BENCH_r10.json BENCH_r11.json
+
+Persistent-conversation-tier refresh (ISSUE 20): the three park HEADLINE
+keys (``serve_resume_ttft_ms_parked``,
+``serve_resident_bytes_per_idle_conv``, ``serve_park_resume_exact``)
+postdate every committed artifact, so ``--park-update`` builds one
+tiny-dims model and re-measures just ``bench.bench_park_resume`` (which
+also records the cold re-prefill basis and durable bytes sidecars):
+
+    JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py \\
+        --park-update BENCH_r11.json BENCH_r12.json
 """
 
 from __future__ import annotations
@@ -309,6 +319,73 @@ def _async_update(base_path: str, out_path: str) -> int:
     return 0
 
 
+def _park_update(base_path: str, out_path: str) -> int:
+    """BENCH_r(x+1) = BENCH_rx + freshly measured persistent-conversation-
+    tier keys (ISSUE 20: the park/resume path postdates every committed
+    serving artifact — without this refresh bench_regress would report
+    the three new HEADLINE keys as new_key forever and the zero-tolerance
+    ``serve_park_resume_exact`` gate would never arm). Builds ONE
+    tiny-dims model and runs just bench.bench_park_resume over it — the
+    same CPU basis (and the same dims) as the carried-over sections; the
+    section runs at its own small fused_steps=4 and parks to a tmpdir
+    store it cleans up."""
+    import jax.numpy as jnp
+
+    import bench
+    from neuronx_distributed_tpu.models.llama import (LlamaConfig,
+                                                      LlamaForCausalLM)
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, neuronx_distributed_config,
+    )
+
+    with open(base_path) as f:
+        base = json.load(f)
+    parsed = dict(base["parsed"])
+
+    prompt_len, max_batch = 128, 4
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    cfg = neuronx_distributed_config(tensor_parallel_size=1)
+    lcfg = LlamaConfig(
+        vocab_size=32000, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=prompt_len + 256, dtype=jnp.float32,
+        param_dtype=jnp.float32, use_flash_attention=False,
+        remat_policy=None)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    model = initialize_parallel_model(cfg, lambda: LlamaForCausalLM(lcfg),
+                                      ids)
+    sec = bench.bench_park_resume(lcfg, model.params,
+                                  prompt_len=prompt_len,
+                                  max_batch=max_batch)
+    parsed.update(sec)
+    parsed["headline_keys"] = list(bench.HEADLINE_KEYS)
+    parsed["serve_cpu_basis"] = (
+        parsed.get("serve_cpu_basis", "")
+        + " | conversation-tier park/resume keys measured by "
+        + "--park-update (fused_steps=4, streams checked bit-identical "
+        + "to the never-parked oracle inline) on top of " + base_path)
+    headline = {k: parsed[k] for k in bench.HEADLINE_KEYS if k in parsed}
+    wrapper = {
+        "n": base.get("n", 0) + 1,
+        "cmd": (f"JAX_PLATFORMS=cpu python scripts/bench_cpu_basis.py "
+                f"--park-update {base_path}"),
+        "rc": 0,
+        "tail": json.dumps(headline),
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(wrapper, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(headline))
+    errors = [k for k in sec if k.endswith("_error")]
+    if errors:
+        print(f"sections failed: {errors}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _tp_update(base_path: str, out_path: str) -> int:
     """BENCH_r0(x+1) = BENCH_r0x + freshly measured TP-sharded-serving
     keys (ISSUE 16: the keys need >= 2 devices, which no committed
@@ -379,6 +456,8 @@ def main() -> int:
         return _kernel_update(sys.argv[2], sys.argv[3])
     if len(sys.argv) >= 4 and sys.argv[1] == "--async-update":
         return _async_update(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 4 and sys.argv[1] == "--park-update":
+        return _park_update(sys.argv[2], sys.argv[3])
 
     import jax.numpy as jnp
 

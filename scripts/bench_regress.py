@@ -122,6 +122,18 @@ RULES: List[tuple] = [
     # tok/s key (named explicitly so its intent survives pattern shifts)
     (r"serve_interblock_gap_ms", "lower", 0.50, 5.0),
     (r"serve_tokens_per_sec_async_smallK", "higher", 0.10),
+    # persistent conversation tier (ISSUE 20): resume-from-park TTFT
+    # gates like every _ms key (named explicitly so its intent survives
+    # pattern shifts); resident KV bytes per idle parked conversation are
+    # 0 BY CONSTRUCTION (park evicts device AND host pages) so a relative
+    # rule is meaningless — any positive byte count is an eviction leak,
+    # zero absolute tolerance; park/resume stream bit-identity vs the
+    # never-parked oracle is zero-tolerance like
+    # serve_structured_parse_rate (1.0 = exact, any drop is a state-
+    # reconstruction bug, not noise)
+    (r"serve_resume_ttft_ms_parked", "lower", 0.15),
+    (r"serve_resident_bytes_per_idle_conv", "lower", 0.0, 0.0),
+    (r"serve_park_resume_exact", "higher", 0.0),
     (r".*fairness_ratio", "lower", 0.15),
     (r".*(prefix_hit_ttft_ratio|hbm_bytes_vs_slab).*", "lower", 0.10),
     # rates where less is better

@@ -184,6 +184,32 @@ def test_scale_events_replay_twice_identical(stack):
     assert _streams(a) == _streams(b)
 
 
+def test_async_fleet_scales_on_same_block_as_sync(stack):
+    """PR 19 remainder: under ``async_loop=True`` every policy signal the
+    autoscaler reads lags the in-flight block by one harvest.  ReplicaLoad
+    stamps ``observed_block`` (the newest block whose effects the summary
+    reflects) and the hysteresis credits the staleness toward patience, so
+    the async fleet's scale events land on the SAME virtual block as the
+    sync fleet's for the same trace — patience thresholds included
+    (up_patience > 1 would otherwise trip one block late)."""
+    _cfg, _params, _lm_c, lm_p = stack
+    trace = _two_burst()
+
+    def run_once(async_loop):
+        r = Router(lm_p, 1, rng=jax.random.key(42), block_steps=K,
+                   async_loop=async_loop,
+                   autoscaler=Autoscaler(_policy(up_patience_blocks=2)))
+        _submit_all(r, trace)
+        r.run()
+        return r
+
+    sync, pipe = run_once(False), run_once(True)
+    assert sync.autoscaler.scale_events, "the workload must produce events"
+    assert (pipe.autoscaler.scale_events
+            == sync.autoscaler.scale_events)
+    assert _streams(pipe) == _streams(sync)
+
+
 # ------------------------------------------------ park -> warm unpark
 
 def test_park_unpark_snapshot_roundtrip_bit_identity(stack):

@@ -281,6 +281,38 @@ def test_inference_runner_serve_host_tier_tiny(capsys):
     assert "host_tier_pages" not in off
 
 
+def test_inference_runner_serve_park_resume_tiny(capsys, tmp_path):
+    """ISSUE 20 CI gate: runner.py serve --park-idle-blocks parks every
+    long-running conversation to the durable tier mid-trace (KV pages +
+    engine state on disk, ZERO device and host residency) and the drive
+    loop resumes each one — every stream still finishes its full token
+    budget, the report carries the park/resume ledger balanced to zero,
+    and the exported trace proves the park and resume events actually
+    fired (not a no-op flag)."""
+    import runner
+
+    trace_out = tmp_path / "park_trace.json"
+    runner.main(["serve", "--tiny", "--paged", "--num_requests", "4",
+                 "--max_new_tokens", "12", "--fused_steps", "3",
+                 "--park-idle-blocks", "2",
+                 "--park-dir", str(tmp_path / "park"),
+                 "--trace_out", str(trace_out)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests_completed"] == 4
+    assert report["total_generated_tokens"] == 4 * 12
+    assert all(r["generated"] == 12 for r in report["per_request"])
+    # the ledger balances: every park matched by an exact resume, no
+    # degradations, and the durable tier drained empty (0 bytes on disk)
+    assert report["parked"] >= 4
+    assert report["resumed"] == report["parked"]
+    assert report["park_replays"] == 0 and report["park_rejects"] == 0
+    assert report["parked_remaining"] == 0
+    assert report["parked_bytes"] == 0
+    events = {ev.get("name") for ev in
+              json.loads(trace_out.read_text())["traceEvents"]}
+    assert {"park", "resume", "tier:park", "tier:resume"} <= events
+
+
 def test_inference_runner_serve_robustness_tiny(capsys):
     """ISSUE 5 CI gate: runner.py serve with deadlines, a bounded queue,
     and a seeded fault plan — the report grows the overload/robustness
